@@ -1,0 +1,417 @@
+"""Rollback world state as a device-resident SoA pytree.
+
+TPU-native replacement for the reference's reflection-based snapshot engine
+(``/root/reference/src/world_snapshot.rs``). Where the reference deep-clones
+every registered component of every ``Rollback``-tagged entity into a
+``WorldSnapshot { entities: Vec<RollbackEntity>, resources, checksum }``
+(``world_snapshot.rs:51-56``), we keep the registered slice of the world as a
+structure-of-arrays pytree permanently resident in HBM:
+
+- ``components[name]``: ``[capacity, *shape]`` array per registered type
+- ``present[name]``:    ``bool[capacity]`` — does this entity have the
+  component? (parity with per-entity insert/remove component semantics,
+  ``world_snapshot.rs:154-184``)
+- ``alive``:            ``bool[capacity]`` — entity exists
+- ``rollback_id``:      ``int32[capacity]`` — the stable identity that
+  survives despawn/respawn across rollbacks (reference ``src/lib.rs:40-55``)
+- ``resources[name]``:  arbitrary array pytrees (reference
+  ``src/reflect_resource.rs``)
+
+"Save" is then a single indexed write into a stacked ring
+(:class:`SnapshotRing`, reference ring at ``src/ggrs_stage.rs:89,286``),
+"load" a gather, and the reference's entity create/destroy reconciliation on
+restore (``world_snapshot.rs:135-235``) is subsumed by restoring the
+alive/present masks — no per-entity spawn/despawn walk.
+
+The checksum mirrors the reference's order-insensitive wrapping sum of
+per-component hashes (``world_snapshot.rs:72-75,123-125``) as a vectorized
+integer reduction: a murmur3-style mix of each live slot's component words,
+wrapping-summed over slots (order-insensitive), plus resource hashes. Integer
+ops only, so it is bit-reproducible under XLA on a given platform.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+# ---------------------------------------------------------------------------
+# Type registry
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ComponentDef:
+    """A registered rollback component type.
+
+    Mirrors a ``register_rollback_component::<T>()`` registration
+    (reference ``src/lib.rs:120-131``): the set of registered types is the
+    gate deciding what crosses into the rollback domain.
+    """
+
+    name: str
+    shape: Tuple[int, ...] = ()
+    dtype: Any = jnp.float32
+    default: Any = 0
+
+    def prototype(self, capacity: int) -> jnp.ndarray:
+        return jnp.full((capacity,) + tuple(self.shape), self.default, dtype=self.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceDef:
+    """A registered rollback resource (singleton) type.
+
+    Mirrors ``register_rollback_resource::<T>()`` (reference
+    ``src/lib.rs:134-146`` + ``src/reflect_resource.rs``). ``initial`` is an
+    arbitrary pytree of arrays/scalars; its structure is the schema.
+    """
+
+    name: str
+    initial: Any = None
+
+    def prototype(self) -> Any:
+        return jax.tree_util.tree_map(jnp.asarray, self.initial)
+
+
+class TypeRegistry:
+    """Collects the component/resource types that constitute rollback state.
+
+    Only registered types are saved, restored, and checksummed — everything
+    else in the user's program is untouched, exactly the boundary the
+    reference draws with its plugin-private ``TypeRegistry``
+    (``src/lib.rs:91,120-146``).
+    """
+
+    def __init__(self) -> None:
+        self.components: Dict[str, ComponentDef] = {}
+        self.resources: Dict[str, ResourceDef] = {}
+
+    def register_component(
+        self,
+        name: str,
+        shape: Tuple[int, ...] = (),
+        dtype: Any = jnp.float32,
+        default: Any = 0,
+    ) -> "TypeRegistry":
+        if name in self.components:
+            raise ValueError(f"component {name!r} registered twice")
+        self.components[name] = ComponentDef(name, tuple(shape), dtype, default)
+        return self
+
+    def register_resource(self, name: str, initial: Any) -> "TypeRegistry":
+        if name in self.resources:
+            raise ValueError(f"resource {name!r} registered twice")
+        self.resources[name] = ResourceDef(name, initial)
+        return self
+
+
+# ---------------------------------------------------------------------------
+# World state pytree
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class WorldState:
+    """The registered slice of the world, as one SoA pytree.
+
+    All leaves share a leading ``capacity`` axis except ``resources``.
+    A free slot has ``alive=False`` and ``rollback_id=-1``.
+    """
+
+    alive: jnp.ndarray  # bool[capacity]
+    rollback_id: jnp.ndarray  # int32[capacity]
+    components: Dict[str, jnp.ndarray]  # name -> [capacity, *shape]
+    present: Dict[str, jnp.ndarray]  # name -> bool[capacity]
+    resources: Dict[str, Any]  # name -> pytree
+
+    @property
+    def capacity(self) -> int:
+        return self.alive.shape[0]
+
+    def num_alive(self) -> jnp.ndarray:
+        return jnp.sum(self.alive.astype(jnp.int32))
+
+
+def init_state(registry: TypeRegistry, capacity: int) -> WorldState:
+    """An empty world with ``capacity`` entity slots."""
+    return WorldState(
+        alive=jnp.zeros((capacity,), dtype=jnp.bool_),
+        rollback_id=jnp.full((capacity,), -1, dtype=jnp.int32),
+        components={n: d.prototype(capacity) for n, d in registry.components.items()},
+        present={n: jnp.zeros((capacity,), dtype=jnp.bool_) for n in registry.components},
+        resources={n: d.prototype() for n, d in registry.resources.items()},
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host-side staging world
+# ---------------------------------------------------------------------------
+
+
+class HostWorld:
+    """Mutable host-side staging area for building the initial world.
+
+    Plays the role of the user's setup system spawning ``Rollback``-tagged
+    entities (reference ``examples/box_game/box_game.rs:80-140``). Call
+    :meth:`commit` to obtain the device-resident :class:`WorldState`.
+    """
+
+    def __init__(self, registry: TypeRegistry, capacity: int):
+        self.registry = registry
+        self.capacity = capacity
+        self._alive = np.zeros((capacity,), dtype=bool)
+        self._rollback_id = np.full((capacity,), -1, dtype=np.int32)
+        self._components = {
+            n: np.full((capacity,) + tuple(d.shape), d.default,
+                       dtype=np.dtype(jnp.dtype(d.dtype).name))
+            for n, d in registry.components.items()
+        }
+        self._present = {n: np.zeros((capacity,), dtype=bool) for n in registry.components}
+        self._resources = {n: d.prototype() for n, d in registry.resources.items()}
+
+    def spawn(self, components: Dict[str, Any], rollback_id: int) -> int:
+        """Spawn an entity with the given components; returns its slot index.
+
+        ``rollback_id`` must be unique among live entities — the reference
+        asserts the same (``world_snapshot.rs:16``).
+        """
+        if rollback_id in self._rollback_id[self._alive]:
+            raise ValueError(f"duplicate rollback_id {rollback_id}")
+        for name in components:
+            if name not in self._components:
+                raise KeyError(f"component {name!r} not registered")
+        free = np.flatnonzero(~self._alive)
+        if free.size == 0:
+            raise RuntimeError(f"world capacity {self.capacity} exhausted")
+        slot = int(free[0])
+        self._alive[slot] = True
+        self._rollback_id[slot] = rollback_id
+        for name, value in components.items():
+            self._components[name][slot] = np.asarray(
+                value, dtype=self._components[name].dtype
+            )
+            self._present[name][slot] = True
+        return slot
+
+    def despawn(self, slot: int) -> None:
+        self._alive[slot] = False
+        self._rollback_id[slot] = -1
+        for name in self._present:
+            self._present[name][slot] = False
+
+    def set_resource(self, name: str, value: Any) -> None:
+        if name not in self._resources:
+            raise KeyError(f"resource {name!r} not registered")
+        proto = self._resources[name]
+        self._resources[name] = jax.tree_util.tree_map(
+            lambda p, v: jnp.asarray(v, dtype=p.dtype), proto, value
+        )
+
+    def commit(self) -> WorldState:
+        return WorldState(
+            alive=jnp.asarray(self._alive),
+            rollback_id=jnp.asarray(self._rollback_id),
+            components={n: jnp.asarray(a) for n, a in self._components.items()},
+            present={n: jnp.asarray(a) for n, a in self._present.items()},
+            resources=jax.tree_util.tree_map(jnp.asarray, self._resources),
+        )
+
+
+def to_host(state: WorldState) -> Dict[str, Any]:
+    """Device→host sync of a world state (the confirmed-branch scatter-back).
+
+    Returns plain numpy arrays; this is the only place rendering/game code
+    outside the rollback domain should read simulated state from.
+    """
+    return jax.tree_util.tree_map(np.asarray, dataclasses.asdict(state))
+
+
+# ---------------------------------------------------------------------------
+# Checksum
+# ---------------------------------------------------------------------------
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+_SEED = np.uint32(0x9747B28C)
+
+
+def _rotl(x: jnp.ndarray, r: int) -> jnp.ndarray:
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def _to_u32_words(arr: jnp.ndarray) -> jnp.ndarray:
+    """Flatten trailing dims of ``[cap, ...]`` to ``[cap, n_words]`` uint32."""
+    cap = arr.shape[0]
+    a = arr.reshape(cap, -1) if arr.ndim > 1 else arr.reshape(cap, 1)
+    if a.dtype == jnp.bool_:
+        return a.astype(jnp.uint32)
+    nbits = a.dtype.itemsize * 8
+    if nbits < 32:
+        uint = jnp.dtype(f"uint{nbits}")
+        return jax.lax.bitcast_convert_type(a, uint).astype(jnp.uint32)
+    if nbits == 32:
+        return jax.lax.bitcast_convert_type(a, jnp.uint32)
+    # 64-bit dtypes only exist with jax x64 enabled; split into 2 words.
+    w = jax.lax.bitcast_convert_type(a, jnp.uint32)  # [cap, n, 2]
+    return w.reshape(cap, -1)
+
+
+def _mix_one(h: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    k = w * _C1
+    k = _rotl(k, 15) * _C2
+    h = h ^ k
+    return _rotl(h, 13) * np.uint32(5) + np.uint32(0xE6546B64)
+
+
+_UNROLL_LIMIT = 64
+
+
+def _mix_words(h: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
+    """Murmur3-style streaming mix of ``words[cap, n]`` into ``h[cap]``.
+
+    Small word counts unroll statically; large components (grids, big
+    per-entity tensors) fall back to ``lax.scan`` over columns so trace size
+    stays bounded.
+    """
+    n = words.shape[1]
+    if n <= _UNROLL_LIMIT:
+        for i in range(n):
+            h = _mix_one(h, words[:, i])
+        return h
+    return jax.lax.scan(
+        lambda hh, col: (_mix_one(hh, col), None), h, jnp.transpose(words)
+    )[0]
+
+
+def _fmix(h: jnp.ndarray) -> jnp.ndarray:
+    h = h ^ (h >> np.uint32(16))
+    h = h * np.uint32(0x85EBCA6B)
+    h = h ^ (h >> np.uint32(13))
+    h = h * np.uint32(0xC2B2AE35)
+    h = h ^ (h >> np.uint32(16))
+    return h
+
+
+def checksum(state: WorldState) -> jnp.ndarray:
+    """Order-insensitive uint32 checksum of the rollback domain.
+
+    Per-slot: a murmur-style hash over ``rollback_id`` and every
+    present component's words (order-sensitive *within* a slot). Slot hashes
+    are wrapping-summed over live slots, so the result is independent of slot
+    order — matching the reference's wrapping ``checksum +=
+    component.reflect_hash()`` (``world_snapshot.rs:72-75``). Resource hashes
+    are mixed in the same way (``world_snapshot.rs:123-125``).
+    """
+    cap = state.capacity
+    h = jnp.full((cap,), _SEED, dtype=jnp.uint32)
+    h = _mix_words(h, _to_u32_words(state.rollback_id))
+    for name in sorted(state.components):
+        words = _to_u32_words(state.components[name])
+        # Mask non-present slots' words to a fixed sentinel so stale slot data
+        # never affects the hash; mix the presence bit itself as well.
+        pres = state.present[name][:, None]
+        words = jnp.where(pres, words, jnp.uint32(0))
+        h = _mix_words(h, state.present[name].astype(jnp.uint32).reshape(cap, 1))
+        h = _mix_words(h, words)
+    h = _fmix(h)
+    total = jnp.sum(jnp.where(state.alive, h, jnp.uint32(0)), dtype=jnp.uint32)
+    # Resources: order-sensitive stream, keyed by sorted name for stability.
+    for name in sorted(state.resources):
+        leaves = jax.tree_util.tree_leaves(state.resources[name])
+        # Seed with the full name so same-length-named resources can't swap
+        # values undetected.
+        name_seed = np.uint32(0)
+        for b in name.encode():
+            name_seed = (name_seed * np.uint32(31) + np.uint32(b)) & np.uint32(0xFFFFFFFF)
+        rh = jnp.full((1,), _SEED ^ name_seed, dtype=jnp.uint32)
+        for leaf in leaves:
+            words = _to_u32_words(jnp.atleast_1d(leaf).reshape(1, -1))
+            rh = _mix_words(rh, words)
+        total = total + _fmix(rh)[0]
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Snapshot ring
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class SnapshotRing:
+    """Device-resident ring of world states, indexed ``frame % depth``.
+
+    Mirrors the reference's ``Vec<WorldSnapshot>`` sized to
+    ``max_prediction()`` and indexed ``frame % len`` (``src/ggrs_stage.rs:89,
+    169-173, 286, 294``) — but "save" is an indexed device write, not a deep
+    reflective clone, and the whole ring stays in HBM.
+    """
+
+    states: WorldState  # every leaf gains a leading [depth] axis
+    frames: jnp.ndarray  # int32[depth], -1 = empty
+    checksums: jnp.ndarray  # uint32[depth]
+
+    @property
+    def depth(self) -> int:
+        return self.frames.shape[0]
+
+
+def ring_init(state: WorldState, depth: int) -> SnapshotRing:
+    """A ring of ``depth`` copies of ``state`` with every slot marked empty."""
+    stacked = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x[None], (depth,) + x.shape), state
+    )
+    return SnapshotRing(
+        states=stacked,
+        frames=jnp.full((depth,), -1, dtype=jnp.int32),
+        checksums=jnp.zeros((depth,), dtype=jnp.uint32),
+    )
+
+
+def ring_save(
+    ring: SnapshotRing, state: WorldState, frame: jnp.ndarray
+) -> Tuple[SnapshotRing, jnp.ndarray]:
+    """Save ``state`` as frame ``frame``; returns (ring, checksum).
+
+    The checksum computed here is what the session hands to its saved-state
+    cell for desync detection — the byte buffer never leaves the device,
+    matching the reference's ``cell.save(frame, None, Some(checksum))``
+    (``src/ggrs_stage.rs:282-283``).
+    """
+    frame = jnp.asarray(frame, dtype=jnp.int32)
+    slot = jnp.remainder(frame, ring.depth)
+    cs = checksum(state)
+    new_states = jax.tree_util.tree_map(
+        lambda r, s: jax.lax.dynamic_update_index_in_dim(r, s, slot, 0),
+        ring.states,
+        state,
+    )
+    return (
+        SnapshotRing(
+            states=new_states,
+            frames=ring.frames.at[slot].set(frame),
+            checksums=ring.checksums.at[slot].set(cs),
+        ),
+        cs,
+    )
+
+
+def ring_load(ring: SnapshotRing, frame: jnp.ndarray) -> WorldState:
+    """Load the state saved for ``frame``. The caller must know it is live
+    (the session protocol guarantees loads target frames within the
+    prediction window, like the reference's ``frame % len`` indexing)."""
+    slot = jnp.remainder(jnp.asarray(frame, dtype=jnp.int32), ring.depth)
+    return jax.tree_util.tree_map(
+        lambda r: jax.lax.dynamic_index_in_dim(r, slot, 0, keepdims=False),
+        ring.states,
+    )
+
+
+def ring_frame_at(ring: SnapshotRing, frame: int) -> int:
+    """Host-side: which frame currently occupies ``frame``'s slot."""
+    return int(ring.frames[frame % ring.depth])

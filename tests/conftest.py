@@ -1,0 +1,12 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+Must set the env vars before jax is imported anywhere — conftest is imported
+first by pytest, so this is the single authoritative place.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

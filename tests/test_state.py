@@ -1,0 +1,209 @@
+"""State core tests: SoA world, snapshot ring, checksum semantics.
+
+Mirrors the behavioral contract of the reference snapshot engine
+(`/root/reference/src/world_snapshot.rs`): save/restore roundtrip including
+entity create/destroy reconciliation, order-insensitive checksum, duplicate
+rollback-id rejection.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu import (
+    HostWorld,
+    TypeRegistry,
+    checksum,
+    init_state,
+    ring_init,
+    ring_load,
+    ring_save,
+)
+
+
+def make_registry():
+    reg = TypeRegistry()
+    reg.register_component("translation", shape=(3,), dtype=jnp.float32)
+    reg.register_component("velocity", shape=(3,), dtype=jnp.float32)
+    reg.register_component("player_handle", shape=(), dtype=jnp.int32, default=-1)
+    reg.register_resource("frame_count", jnp.int32(0))
+    return reg
+
+
+def make_world(reg, capacity=8):
+    w = HostWorld(reg, capacity)
+    w.spawn({"translation": [1.0, 2.0, 3.0], "velocity": [0.0, 0.0, 0.0],
+             "player_handle": 0}, rollback_id=0)
+    w.spawn({"translation": [-1.0, 0.5, 0.0], "velocity": [0.1, 0.0, 0.0],
+             "player_handle": 1}, rollback_id=1)
+    return w
+
+
+def test_spawn_commit_roundtrip():
+    reg = make_registry()
+    state = make_world(reg).commit()
+    assert state.capacity == 8
+    assert int(state.num_alive()) == 2
+    np.testing.assert_array_equal(np.asarray(state.rollback_id[:2]), [0, 1])
+    np.testing.assert_allclose(np.asarray(state.components["translation"][0]), [1, 2, 3])
+    assert bool(state.present["player_handle"][1])
+    assert not bool(state.present["translation"][2])
+
+
+def test_duplicate_rollback_id_rejected():
+    reg = make_registry()
+    w = make_world(reg)
+    with pytest.raises(ValueError):
+        w.spawn({"translation": [0, 0, 0]}, rollback_id=0)
+
+
+def test_capacity_exhaustion():
+    reg = make_registry()
+    w = HostWorld(reg, 2)
+    w.spawn({}, rollback_id=0)
+    w.spawn({}, rollback_id=1)
+    with pytest.raises(RuntimeError):
+        w.spawn({}, rollback_id=2)
+
+
+def test_checksum_changes_with_state():
+    reg = make_registry()
+    state = make_world(reg).commit()
+    c0 = int(checksum(state))
+    moved = state.replace(
+        components={**state.components,
+                    "translation": state.components["translation"].at[0, 0].add(1.0)}
+    )
+    assert int(checksum(moved)) != c0
+
+
+def test_checksum_order_insensitive():
+    """Same entities in different slots must hash identically — the reference
+    checksum is a wrapping sum over entities, not a sequential digest
+    (world_snapshot.rs:72-75)."""
+    reg = make_registry()
+    a = HostWorld(reg, 8)
+    a.spawn({"translation": [1.0, 2.0, 3.0]}, rollback_id=7)
+    a.spawn({"velocity": [4.0, 5.0, 6.0]}, rollback_id=9)
+    b = HostWorld(reg, 8)
+    b.spawn({"velocity": [4.0, 5.0, 6.0]}, rollback_id=9)
+    b.spawn({"translation": [1.0, 2.0, 3.0]}, rollback_id=7)
+    assert int(checksum(a.commit())) == int(checksum(b.commit()))
+
+
+def test_checksum_ignores_dead_slot_garbage():
+    """Stale component data in dead/non-present slots must not affect the
+    checksum, or resimulated worlds with different spawn histories would
+    falsely desync."""
+    reg = make_registry()
+    state = make_world(reg, 4).commit()
+    dirty = state.replace(
+        components={**state.components,
+                    "translation": state.components["translation"].at[3].set(99.0)}
+    )
+    assert int(checksum(state)) == int(checksum(dirty))
+
+
+def test_checksum_sees_resources():
+    reg = make_registry()
+    state = make_world(reg).commit()
+    bumped = state.replace(resources={"frame_count": jnp.int32(5)})
+    assert int(checksum(state)) != int(checksum(bumped))
+
+
+def test_checksum_distinguishes_present_from_default():
+    """An entity *with* a component at its default value differs from one
+    *without* the component (insert vs. absent — world_snapshot.rs:154-184)."""
+    reg = make_registry()
+    a = HostWorld(reg, 4)
+    a.spawn({"translation": [0.0, 0.0, 0.0]}, rollback_id=0)
+    b = HostWorld(reg, 4)
+    b.spawn({}, rollback_id=0)
+    assert int(checksum(a.commit())) != int(checksum(b.commit()))
+
+
+def test_ring_save_load_roundtrip():
+    reg = make_registry()
+    state = make_world(reg).commit()
+    ring = ring_init(state, depth=4)
+    ring, cs = ring_save(ring, state, 0)
+    assert int(ring.frames[0]) == 0
+    assert int(cs) == int(checksum(state))
+
+    moved = state.replace(
+        components={**state.components,
+                    "translation": state.components["translation"] + 1.0}
+    )
+    ring, _ = ring_save(ring, moved, 1)
+
+    back0 = ring_load(ring, 0)
+    back1 = ring_load(ring, 1)
+    np.testing.assert_array_equal(
+        np.asarray(back0.components["translation"]),
+        np.asarray(state.components["translation"]),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(back1.components["translation"]),
+        np.asarray(moved.components["translation"]),
+    )
+
+
+def test_ring_wraparound_overwrites():
+    """frame % depth indexing (ggrs_stage.rs:286,294): frame depth+k lands on
+    slot k, overwriting the old snapshot."""
+    reg = make_registry()
+    state = make_world(reg).commit()
+    ring = ring_init(state, depth=3)
+    for f in range(5):
+        bumped = state.replace(resources={"frame_count": jnp.int32(f)})
+        ring, _ = ring_save(ring, bumped, f)
+    np.testing.assert_array_equal(np.asarray(ring.frames), [3, 4, 2])
+    assert int(ring_load(ring, 4).resources["frame_count"]) == 4
+
+
+def test_restore_reconciles_spawn_despawn():
+    """Entities created during mispredicted frames vanish on restore; entities
+    destroyed during mispredicted frames come back — the reference walks
+    spawn/despawn per entity (world_snapshot.rs:140-151,190-193); here the
+    alive mask restore does it wholesale."""
+    reg = make_registry()
+    host = make_world(reg)
+    state = host.commit()
+    ring = ring_init(state, depth=4)
+    ring, _ = ring_save(ring, state, 0)
+
+    # Mispredicted future: entity 0 despawned, a new entity spawned in slot 2.
+    mutated = state.replace(
+        alive=state.alive.at[0].set(False).at[2].set(True),
+        rollback_id=state.rollback_id.at[0].set(-1).at[2].set(77),
+    )
+    restored = ring_load(ring, 0)
+    np.testing.assert_array_equal(np.asarray(restored.alive), np.asarray(state.alive))
+    np.testing.assert_array_equal(
+        np.asarray(restored.rollback_id), np.asarray(state.rollback_id)
+    )
+    assert int(checksum(restored)) == int(checksum(state))
+    assert int(checksum(mutated)) != int(checksum(state))
+
+
+def test_ring_ops_jittable():
+    reg = make_registry()
+    state = make_world(reg).commit()
+    ring = ring_init(state, depth=4)
+
+    @jax.jit
+    def save_then_load(ring, state, frame):
+        ring, cs = ring_save(ring, state, frame)
+        return ring_load(ring, frame), cs
+
+    back, cs = save_then_load(ring, state, jnp.int32(2))
+    assert int(cs) == int(checksum(state))
+    np.testing.assert_array_equal(np.asarray(back.alive), np.asarray(state.alive))
+
+
+def test_empty_registry_state():
+    reg = TypeRegistry()
+    state = init_state(reg, 4)
+    assert int(state.num_alive()) == 0
+    int(checksum(state))  # must not crash on empty component/resource dicts
