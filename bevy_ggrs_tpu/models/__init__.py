@@ -1,0 +1,5 @@
+"""Example game models (the reference's ``examples/`` analog): each model
+provides a registry, a setup/spawn routine, and a rollback schedule of pure
+systems."""
+
+from bevy_ggrs_tpu.models import box_game

@@ -1,0 +1,260 @@
+"""box_game: the reference's example game, as a vectorized JAX step.
+
+Behavioral parity with ``/root/reference/examples/box_game/box_game.rs``:
+
+- input is a per-player ``u8`` bitmask (UP/DOWN/LEFT/RIGHT, ``box_game.rs:
+  13-16,34-38``),
+- each player cube accelerates on exclusive key presses, gets friction when
+  neither opposing key is held, speed-clamps to ``MAX_SPEED``, integrates
+  velocity into translation, and clamps to the plane bounds
+  (``move_cube_system``, ``box_game.rs:154-203``),
+- a ``frame_count`` rollback resource increments each simulated frame
+  (``increase_frame_system``, ``box_game.rs:145-148``),
+- players spawn on a circle of radius ``PLANE_SIZE/4`` at height
+  ``CUBE_SIZE/2`` (``setup_system``, ``box_game.rs:106-119``).
+
+Where the reference loops over query results entity by entity, this steps ALL
+entities as one masked SoA update — the same math, vectorized, so ``vmap``
+over speculative branches and ``lax.scan`` over frames stay fused on device.
+
+A NumPy twin (:func:`move_cubes_np`, :func:`step_np`) implements the identical
+operation order in float32 for bit-exact cross-checks — the SyncTest
+determinism strategy of §4 of the survey (simulate vs. resimulate must agree
+bitwise).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from bevy_ggrs_tpu.schedule import InputSpec, PlayerInputs, Schedule
+from bevy_ggrs_tpu.state import HostWorld, TypeRegistry, WorldState
+
+# Input bitmask (box_game.rs:13-16).
+INPUT_UP = 1 << 0
+INPUT_DOWN = 1 << 1
+INPUT_LEFT = 1 << 2
+INPUT_RIGHT = 1 << 3
+
+# Physics constants (box_game.rs:18-22).
+MOVEMENT_SPEED = 0.005
+MAX_SPEED = 0.05
+FRICTION = 0.9
+PLANE_SIZE = 5.0
+CUBE_SIZE = 0.2
+
+INPUT_SPEC = InputSpec(shape=(), dtype=jnp.uint8)
+
+
+def make_registry() -> TypeRegistry:
+    """The rollback type registrations of the box_game example.
+
+    Mirrors ``register_rollback_component::<Transform/Velocity/...>()`` +
+    ``register_rollback_resource`` calls (intent shown at
+    ``examples/box_game/box_game_p2p.rs:66-70``; Transform, Velocity, Player
+    components at ``box_game.rs:40-59``).
+    """
+    reg = TypeRegistry()
+    reg.register_component("translation", shape=(3,), dtype=jnp.float32)
+    reg.register_component("velocity", shape=(3,), dtype=jnp.float32)
+    reg.register_component("player_handle", shape=(), dtype=jnp.int32, default=-1)
+    reg.register_resource("frame_count", jnp.uint32(0))
+    return reg
+
+
+def spawn_players(world: HostWorld, num_players: int, next_id=None) -> None:
+    """Spawn one rollback-tagged cube per player on the setup circle
+    (``box_game.rs:106-130``). ``next_id`` is a callable handing out unique
+    rollback ids (the ``RollbackIdProvider`` role, ``src/lib.rs:59-75``)."""
+    if next_id is None:
+        counter = iter(range(num_players))
+        next_id = lambda: next(counter)
+    r = PLANE_SIZE / 4.0
+    for handle in range(num_players):
+        rot = handle / num_players * 2.0 * math.pi
+        world.spawn(
+            {
+                "translation": np.array(
+                    [r * math.cos(rot), CUBE_SIZE / 2.0, r * math.sin(rot)],
+                    dtype=np.float32,
+                ),
+                "velocity": np.zeros(3, dtype=np.float32),
+                "player_handle": handle,
+            },
+            rollback_id=next_id(),
+        )
+
+
+def make_world(num_players: int, capacity: int = 16) -> HostWorld:
+    world = HostWorld(make_registry(), capacity)
+    spawn_players(world, num_players)
+    return world
+
+
+# ---------------------------------------------------------------------------
+# Systems (JAX)
+# ---------------------------------------------------------------------------
+
+
+def move_cube_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
+    """Vectorized ``move_cube_system`` (``box_game.rs:154-203``).
+
+    Per entity with a player handle: exclusive UP/DOWN accelerates z,
+    exclusive LEFT/RIGHT accelerates x, friction applies per axis when neither
+    key of the pair is held, y always gets friction, the velocity vector is
+    clamped to ``MAX_SPEED``, translation integrates velocity and is clamped
+    to the plane. Non-player / dead slots pass through unchanged.
+    """
+    t = state.components["translation"]
+    v = state.components["velocity"]
+    handle = state.components["player_handle"]
+
+    num_players = inputs.num_players
+    safe_handle = jnp.clip(handle, 0, num_players - 1)
+    inp = inputs.bits[safe_handle].astype(jnp.uint32)  # [capacity]
+
+    up = (inp & INPUT_UP) != 0
+    down = (inp & INPUT_DOWN) != 0
+    left = (inp & INPUT_LEFT) != 0
+    right = (inp & INPUT_RIGHT) != 0
+
+    speed = jnp.float32(MOVEMENT_SPEED)
+    friction = jnp.float32(FRICTION)
+
+    vx, vy, vz = v[:, 0], v[:, 1], v[:, 2]
+    # Exclusive press accelerates; neither pressed → friction; both → as-is.
+    vz = jnp.where(up & ~down, vz - speed, vz)
+    vz = jnp.where(down & ~up, vz + speed, vz)
+    vz = jnp.where(~up & ~down, vz * friction, vz)
+    vx = jnp.where(left & ~right, vx - speed, vx)
+    vx = jnp.where(right & ~left, vx + speed, vx)
+    vx = jnp.where(~left & ~right, vx * friction, vx)
+    vy = vy * friction
+
+    mag = jnp.sqrt(vx * vx + vy * vy + vz * vz)
+    factor = jnp.where(mag > jnp.float32(MAX_SPEED),
+                       jnp.float32(MAX_SPEED) / mag, jnp.float32(1.0))
+    vx, vy, vz = vx * factor, vy * factor, vz * factor
+
+    half = jnp.float32((PLANE_SIZE - CUBE_SIZE) * 0.5)
+    tx = jnp.clip(t[:, 0] + vx, -half, half)
+    ty = t[:, 1] + vy
+    tz = jnp.clip(t[:, 2] + vz, -half, half)
+
+    new_t = jnp.stack([tx, ty, tz], axis=1)
+    new_v = jnp.stack([vx, vy, vz], axis=1)
+
+    # Mutate only live entities that actually carry the full player bundle —
+    # the reference's `With<Rollback>` + query-shape filter (box_game.rs:155).
+    sel = (
+        state.alive
+        & state.present["player_handle"]
+        & state.present["translation"]
+        & state.present["velocity"]
+        & (handle >= 0)
+    )[:, None]
+    return state.replace(
+        components={
+            **state.components,
+            "translation": jnp.where(sel, new_t, t),
+            "velocity": jnp.where(sel, new_v, v),
+        }
+    )
+
+
+def increase_frame_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
+    """``increase_frame_system`` (``box_game.rs:145-148``)."""
+    del inputs
+    return state.replace(
+        resources={
+            **state.resources,
+            "frame_count": state.resources["frame_count"] + jnp.uint32(1),
+        }
+    )
+
+
+def make_schedule() -> Schedule:
+    """The example's rollback schedule: move cubes, then bump the frame
+    counter (wiring intent at ``box_game_p2p.rs:71-80``)."""
+    return Schedule([move_cube_system, increase_frame_system])
+
+
+# ---------------------------------------------------------------------------
+# NumPy twin (bit-exact determinism oracle)
+# ---------------------------------------------------------------------------
+
+
+def move_cubes_np(
+    translation: np.ndarray,
+    velocity: np.ndarray,
+    handles: np.ndarray,
+    mask: np.ndarray,
+    input_bits: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Float32 NumPy implementation with the exact same operation order as
+    :func:`move_cube_system`; used to certify the JAX step bit-reproducible."""
+    t = translation.astype(np.float32).copy()
+    v = velocity.astype(np.float32).copy()
+    speed = np.float32(MOVEMENT_SPEED)
+    friction = np.float32(FRICTION)
+    for i in np.flatnonzero(mask):
+        inp = int(input_bits[int(handles[i])])
+        up, down = inp & INPUT_UP, inp & INPUT_DOWN
+        left, right = inp & INPUT_LEFT, inp & INPUT_RIGHT
+        vx, vy, vz = v[i, 0], v[i, 1], v[i, 2]
+        if up and not down:
+            vz = vz - speed
+        if down and not up:
+            vz = vz + speed
+        if not up and not down:
+            vz = vz * friction
+        if left and not right:
+            vx = vx - speed
+        if right and not left:
+            vx = vx + speed
+        if not left and not right:
+            vx = vx * friction
+        vy = vy * friction
+        mag = np.float32(np.sqrt(vx * vx + vy * vy + vz * vz))
+        if mag > np.float32(MAX_SPEED):
+            factor = np.float32(MAX_SPEED) / mag
+            vx, vy, vz = vx * factor, vy * factor, vz * factor
+        half = np.float32((PLANE_SIZE - CUBE_SIZE) * 0.5)
+        tx = min(max(t[i, 0] + vx, -half), half)
+        ty = t[i, 1] + vy
+        tz = min(max(t[i, 2] + vz, -half), half)
+        t[i] = [tx, ty, tz]
+        v[i] = [vx, vy, vz]
+    return t, v
+
+
+def step_np(host: Dict[str, np.ndarray], input_bits: np.ndarray) -> Dict[str, np.ndarray]:
+    """One frame of box_game on host arrays (as produced by
+    ``state.to_host``); the CPU oracle for the golden integration test."""
+    mask = (
+        host["alive"]
+        & host["present"]["player_handle"]
+        & host["present"]["translation"]
+        & host["present"]["velocity"]
+        & (host["components"]["player_handle"] >= 0)
+    )
+    t, v = move_cubes_np(
+        host["components"]["translation"],
+        host["components"]["velocity"],
+        host["components"]["player_handle"],
+        mask,
+        input_bits,
+    )
+    out = {
+        **host,
+        "components": {**host["components"], "translation": t, "velocity": v},
+        "resources": {
+            **host["resources"],
+            "frame_count": np.uint32(host["resources"]["frame_count"] + np.uint32(1)),
+        },
+    }
+    return out

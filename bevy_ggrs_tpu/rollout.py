@@ -1,0 +1,195 @@
+"""Fused rollback/resimulation rollouts.
+
+The reference executes a misprediction-recovery burst serially on the host:
+``handle_requests`` walks ``[LoadGameState(F_c), SaveGameState(F_c),
+AdvanceFrame(i_c), …, SaveGameState(F_now), AdvanceFrame(i_now)]`` one request
+at a time, each save a deep reflective clone and each advance a full schedule
+run (``/root/reference/src/ggrs_stage.rs:259-306``) — up to ``max_prediction``
+(12) restore+resimulate cycles inside one render frame.
+
+Here the whole burst is ONE device call: ``lax.scan`` over the frame axis of
+a padded input tensor, with the snapshot ring save folded into each step and
+per-frame checksums streamed out. The host only receives the checksums (the
+session's desync/synctest signal — reference hands ggrs exactly that,
+``ggrs_stage.rs:282-283``); ring and world state never leave HBM.
+
+Bursts are padded to a fixed ``max_frames`` with a validity mask so every
+burst length hits the same compiled executable (static shapes — no
+per-depth recompiles). Invalid steps are identity: no state advance, no ring
+write, checksum reported as 0.
+
+The save-before-advance ordering and the "save is labeled with the current
+frame" invariant (``ggrs_stage.rs:277``'s ``assert_eq!(self.frame, frame)``)
+are preserved: step ``t`` saves frame ``start_frame + t`` then advances with
+that frame's inputs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bevy_ggrs_tpu.schedule import PlayerInputs, Schedule
+from bevy_ggrs_tpu.state import SnapshotRing, WorldState, checksum, ring_load, ring_save
+
+
+def _masked_ring_save(
+    ring: SnapshotRing, state: WorldState, frame: jnp.ndarray, valid: jnp.ndarray
+) -> Tuple[SnapshotRing, jnp.ndarray]:
+    """ring_save that is a no-op (and yields checksum 0) when ``valid`` is
+    False. Select-based: XLA fuses the per-leaf selects into the update."""
+    new_ring, cs = ring_save(ring, state, frame)
+    keep = lambda new, old: jnp.where(valid, new, old)
+    merged = jax.tree_util.tree_map(keep, new_ring, ring)
+    return merged, jnp.where(valid, cs, jnp.uint32(0))
+
+
+def rollout_burst(
+    schedule: Schedule,
+    ring: SnapshotRing,
+    state: WorldState,
+    start_frame: jnp.ndarray,
+    bits: jnp.ndarray,  # [max_frames, num_players, *input_shape]
+    status: jnp.ndarray,  # int32[max_frames, num_players]
+    save_mask: jnp.ndarray,  # bool[max_frames]
+    adv_mask: jnp.ndarray,  # bool[max_frames]
+) -> Tuple[SnapshotRing, WorldState, jnp.ndarray]:
+    """Execute up to ``max_frames`` (save?, advance?) steps as one fused scan.
+
+    Step ``t``: if ``save_mask[t]``, save ``state`` as the current frame into
+    the ring; if ``adv_mask[t]``, ``state = schedule(state, inputs[t])`` and
+    the frame counter increments. Steps with both masks False are padding.
+    Spectators advance without ever saving (`ggrs_stage.rs:195-211` never
+    emits saves), hence the separate masks.
+
+    Returns ``(ring, state, checksums[max_frames])`` with ``checksums[t]``
+    the saved checksum at step ``t`` (0 where ``save_mask[t]`` is False).
+    """
+    start_frame = jnp.asarray(start_frame, dtype=jnp.int32)
+
+    def body(carry, xs):
+        ring, state, frame = carry
+        b, s, sv, adv = xs
+        ring, cs = _masked_ring_save(ring, state, frame, sv)
+        advanced = schedule(state, PlayerInputs(bits=b, status=s))
+        state = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(adv, new, old), advanced, state
+        )
+        return (ring, state, frame + adv.astype(jnp.int32)), cs
+
+    (ring, state, _), checksums = jax.lax.scan(
+        body, (ring, state, start_frame), (bits, status, save_mask, adv_mask)
+    )
+    return ring, state, checksums
+
+
+class RolloutExecutor:
+    """Jit-compiled request-burst executor bound to one schedule + shapes.
+
+    The session drivers translate their ``GGRSRequest`` lists (reference
+    ``ggrs_stage.rs:259-269``) into at most one ``run()`` per
+    ``advance_frame`` — the fusion that replaces the reference's serial
+    request loop. Bursts always pad to ``max_frames`` so every call hits the
+    same compiled executable.
+
+    ``max_frames`` should be ``max_prediction + 2`` so the deepest possible
+    rollback (load + full-window resimulate + the new frame) still fits one
+    call.
+    """
+
+    def __init__(self, schedule: Schedule, max_frames: int):
+        self.schedule = schedule
+        self.max_frames = int(max_frames)
+        self._fn = jax.jit(functools.partial(self._run_impl, schedule))
+
+    @staticmethod
+    def _run_impl(schedule, ring, state, do_load, load_frame, start_frame,
+                  bits, status, save_mask, adv_mask):
+        loaded = ring_load(ring, load_frame)
+        state = jax.tree_util.tree_map(
+            lambda l, s: jnp.where(do_load, l, s), loaded, state
+        )
+        frame0 = jnp.where(do_load, jnp.asarray(load_frame, jnp.int32),
+                           jnp.asarray(start_frame, jnp.int32))
+        return rollout_burst(schedule, ring, state, frame0, bits, status,
+                             save_mask, adv_mask)
+
+    def run(
+        self,
+        ring: SnapshotRing,
+        state: WorldState,
+        start_frame: int,
+        bits,
+        status,
+        n_frames: int,
+        load_frame: Optional[int] = None,
+        save_mask=None,
+        adv_mask=None,
+    ) -> Tuple[SnapshotRing, WorldState, jnp.ndarray]:
+        """Pad a host-assembled burst to ``max_frames`` and dispatch it.
+
+        ``bits``/``status`` are host arrays of shape ``[n_frames, players,
+        …]``; ``load_frame=None`` means no rollback (plain steps from
+        ``start_frame``). ``save_mask``/``adv_mask`` default to all-True over
+        the first ``n_frames`` steps (the standard (save, advance) pairing).
+        """
+        import numpy as np
+
+        if n_frames > self.max_frames:
+            raise ValueError(
+                f"burst of {n_frames} frames exceeds max_frames={self.max_frames}"
+            )
+        bits = np.asarray(bits)
+        status = np.asarray(status)
+        pad = self.max_frames - n_frames
+        if pad:
+            bits = np.concatenate(
+                [bits, np.zeros((pad,) + bits.shape[1:], bits.dtype)], axis=0
+            )
+            status = np.concatenate(
+                [status, np.zeros((pad,) + status.shape[1:], status.dtype)], axis=0
+            )
+        valid = np.arange(self.max_frames) < n_frames
+        save_mask = valid if save_mask is None else (
+            np.concatenate([np.asarray(save_mask, bool),
+                            np.zeros(pad, bool)]) & valid
+        )
+        adv_mask = valid if adv_mask is None else (
+            np.concatenate([np.asarray(adv_mask, bool),
+                            np.zeros(pad, bool)]) & valid
+        )
+        do_load = load_frame is not None
+        ring, state, checksums = self._fn(
+            ring,
+            state,
+            jnp.asarray(do_load),
+            jnp.asarray(load_frame if do_load else 0, jnp.int32),
+            jnp.asarray(start_frame, jnp.int32),
+            jnp.asarray(bits),
+            jnp.asarray(status, jnp.int32),
+            jnp.asarray(save_mask),
+            jnp.asarray(adv_mask),
+        )
+        return ring, state, checksums
+
+
+def advance_n(
+    schedule: Schedule,
+    state: WorldState,
+    bits: jnp.ndarray,
+    status: Optional[jnp.ndarray] = None,
+) -> WorldState:
+    """Plain N-frame advance (no ring, no checksums): ``lax.scan`` of the
+    schedule over the leading frame axis of ``bits``. The building block the
+    speculative engine vmaps over branches."""
+    if status is None:
+        status = jnp.zeros(bits.shape[:2], dtype=jnp.int32)
+
+    def body(state, xs):
+        b, s = xs
+        return schedule(state, PlayerInputs(bits=b, status=s)), None
+
+    return jax.lax.scan(body, state, (bits, status))[0]

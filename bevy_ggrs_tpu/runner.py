@@ -1,0 +1,166 @@
+"""RollbackRunner: executes session request lists on the device.
+
+The driver half of the reference's ``GGRSStage`` request handling
+(`/root/reference/src/ggrs_stage.rs:259-306`): it owns the device-resident
+world state, snapshot ring, and frame counter, and executes each
+``advance_frame()`` request list. Where the reference walks requests serially
+(one world restore / schedule run / reflective clone per request), this
+runner splits the list into ``[Load?, (Save?, Advance?)*]`` segments at
+``LoadGameState`` boundaries and dispatches each segment as ONE fused device
+rollout (:class:`bevy_ggrs_tpu.rollout.RolloutExecutor`).
+
+Invariants enforced (the reference's compatibility contract):
+- every ``SaveGameState.frame`` must equal the runner's current frame —
+  the ``assert_eq!(self.frame, frame)`` at `ggrs_stage.rs:277`;
+- ``AdvanceFrame`` bumps the frame by one (`ggrs_stage.rs:305`);
+- ``LoadGameState`` rewinds the frame (`ggrs_stage.rs:291`).
+
+Checksums of saved frames are reported back to the session via
+``session.report_checksum(frame, cs)`` — the ``GameStateCell::save(frame,
+None, Some(checksum))`` analog (`ggrs_stage.rs:282-283`). Note this forces a
+device sync per request list; sessions that don't need checksums every frame
+(plain P2P) can pass ``report_checksums=False`` at construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bevy_ggrs_tpu.rollout import RolloutExecutor
+from bevy_ggrs_tpu.schedule import Schedule
+from bevy_ggrs_tpu.session.requests import AdvanceFrame, LoadGameState, SaveGameState
+from bevy_ggrs_tpu.state import WorldState, ring_init, to_host
+
+
+@dataclasses.dataclass
+class _Step:
+    save_frame: Optional[int] = None
+    adv: Optional[AdvanceFrame] = None
+
+
+class RollbackRunner:
+    def __init__(
+        self,
+        schedule: Schedule,
+        initial_state: WorldState,
+        max_prediction: int,
+        num_players: int,
+        input_spec,
+        report_checksums: bool = True,
+    ):
+        self.schedule = schedule
+        self.state = initial_state
+        self.num_players = int(num_players)
+        self.input_spec = input_spec
+        self.max_prediction = int(max_prediction)
+        # Ring depth mirrors the reference's max_prediction sizing
+        # (`ggrs_stage.rs:169-173,219-224`) +1 slack for the save of the
+        # frame being left.
+        self.ring = ring_init(initial_state, self.max_prediction + 1)
+        self.executor = RolloutExecutor(schedule, self.max_prediction + 2)
+        self.frame = 0
+        self.report_checksums = report_checksums
+        self.rollback_frames_total = 0  # observability: resimulated frames
+        self.rollbacks_total = 0
+
+    # ------------------------------------------------------------------
+
+    def handle_requests(self, requests: Sequence[object], session=None) -> None:
+        """Execute a request list in order (`ggrs_stage.rs:259-269`
+        semantics), fused per Load-delimited segment."""
+        segments = self._segment(requests)
+        for load_frame, steps in segments:
+            self._run_segment(load_frame, steps, session)
+
+    def _segment(
+        self, requests: Sequence[object]
+    ) -> List[Tuple[Optional[int], List[_Step]]]:
+        segments: List[Tuple[Optional[int], List[_Step]]] = []
+        load: Optional[int] = None
+        steps: List[_Step] = []
+        for req in requests:
+            if isinstance(req, LoadGameState):
+                if steps or load is not None:
+                    segments.append((load, steps))
+                load, steps = req.frame, []
+            elif isinstance(req, SaveGameState):
+                steps.append(_Step(save_frame=req.frame))
+            elif isinstance(req, AdvanceFrame):
+                if steps and steps[-1].adv is None:
+                    steps[-1].adv = req
+                else:
+                    steps.append(_Step(adv=req))
+            else:
+                raise TypeError(f"unknown request {req!r}")
+        if steps or load is not None:
+            segments.append((load, steps))
+        return segments
+
+    def _run_segment(
+        self, load_frame: Optional[int], steps: List[_Step], session
+    ) -> None:
+        # Host-side frame bookkeeping + invariant checks.
+        frame = self.frame if load_frame is None else load_frame
+        start_frame = frame
+        save_frames: List[Optional[int]] = []
+        for step in steps:
+            if step.save_frame is not None and step.save_frame != frame:
+                raise AssertionError(
+                    f"save frame {step.save_frame} != driver frame {frame} "
+                    "(ggrs_stage.rs:277 invariant)"
+                )
+            save_frames.append(step.save_frame)
+            if step.adv is not None:
+                frame += 1
+
+        n = len(steps)
+        if n == 0 and load_frame is not None:
+            # Bare Load with no resimulation steps: still restore the state.
+            from bevy_ggrs_tpu.state import ring_load
+
+            self.state = ring_load(self.ring, load_frame)
+        if n:
+            zero_bits = self.input_spec.zeros_np(self.num_players)
+            bits = np.stack(
+                [s.adv.bits if s.adv is not None else zero_bits for s in steps]
+            )
+            status = np.stack(
+                [
+                    s.adv.status
+                    if s.adv is not None
+                    else np.zeros(self.num_players, np.int32)
+                    for s in steps
+                ]
+            )
+            save_mask = np.array([s.save_frame is not None for s in steps])
+            adv_mask = np.array([s.adv is not None for s in steps])
+            self.ring, self.state, checksums = self.executor.run(
+                self.ring,
+                self.state,
+                start_frame,
+                bits,
+                status,
+                n_frames=n,
+                load_frame=load_frame,
+                save_mask=save_mask,
+                adv_mask=adv_mask,
+            )
+            if session is not None and self.report_checksums and save_mask.any():
+                cs_host = np.asarray(checksums)
+                for t, sf in enumerate(save_frames):
+                    if sf is not None:
+                        session.report_checksum(sf, int(cs_host[t]))
+        if load_frame is not None:
+            self.rollbacks_total += 1
+            self.rollback_frames_total += sum(1 for s in steps if s.adv is not None)
+        self.frame = frame
+
+    # ------------------------------------------------------------------
+
+    def world(self):
+        """Host copy of the current world (the confirmed-state scatter-back
+        boundary — the only place non-rollback code should read from)."""
+        return to_host(self.state)

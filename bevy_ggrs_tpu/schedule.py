@@ -1,0 +1,112 @@
+"""Step engine: pure-function systems composed into a rollback schedule.
+
+TPU-native replacement for the reference's user-owned Bevy ``Schedule`` that
+``GGRSStage`` runs once per simulated frame (``/root/reference/src/
+ggrs_stage.rs:301-306``: insert ``PlayerInputs`` resource → ``schedule.
+run_once(world)`` → remove resource). Here the schedule is a composition of
+pure ``(WorldState, PlayerInputs) -> WorldState`` functions, so one simulated
+frame is a single traced function XLA can fuse end to end — and ``lax.scan``
+over it is a whole resimulation burst (see :mod:`bevy_ggrs_tpu.rollout`).
+
+The reference runs systems on a thread pool (``SystemStage::parallel()``,
+``examples/box_game/box_game_p2p.rs:74``); the TPU analog is XLA op-level
+fusion inside the compiled step, so systems compose sequentially here and
+the compiler extracts the parallelism.
+
+Inputs are positional per player, mirroring the ``PlayerInputs<T>`` resource
+(``ggrs_stage.rs:60-75``): game systems index ``inputs.bits[player_handle]``
+exactly like the reference's ``inputs[p.handle].0`` (``examples/box_game/
+box_game.rs:159``). Each input carries an ``InputStatus`` (confirmed /
+predicted / disconnected — ggrs ``InputStatus`` consumed at
+``ggrs_stage.rs:61``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from bevy_ggrs_tpu.state import WorldState
+
+# ggrs::InputStatus analog (per player, per frame).
+CONFIRMED = 0
+PREDICTED = 1
+DISCONNECTED = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class InputSpec:
+    """Shape/dtype of one player's input for one frame.
+
+    The reference requires ``Config::Input: Pod`` (a flat byte struct,
+    ``examples/box_game/box_game.rs:34-38``); here the input is a fixed-shape
+    integer array. Default matches box_game's single ``u8`` bitmask.
+    """
+
+    shape: Tuple[int, ...] = ()
+    dtype: Any = jnp.uint8
+
+    def zeros(self, num_players: int) -> jnp.ndarray:
+        return jnp.zeros((num_players,) + self.shape, dtype=self.dtype)
+
+    def zeros_np(self, num_players: int) -> np.ndarray:
+        return np.zeros((num_players,) + self.shape,
+                        dtype=np.dtype(jnp.dtype(self.dtype).name))
+
+
+@struct.dataclass
+class PlayerInputs:
+    """Confirmed-or-predicted inputs for ALL players for one simulated frame.
+
+    Mirrors ``PlayerInputs<T>(Vec<(T::Input, InputStatus)>)``
+    (``src/ggrs_stage.rs:60-75``). ``bits[p]`` is player ``p``'s input payload;
+    ``status[p]`` is CONFIRMED / PREDICTED / DISCONNECTED.
+    """
+
+    bits: jnp.ndarray  # [num_players, *input_shape]
+    status: jnp.ndarray  # int32[num_players]
+
+    @property
+    def num_players(self) -> int:
+        return self.status.shape[0]
+
+
+def make_inputs(bits, status=None) -> PlayerInputs:
+    bits = jnp.asarray(bits)
+    if status is None:
+        status = jnp.zeros((bits.shape[0],), dtype=jnp.int32)
+    return PlayerInputs(bits=bits, status=jnp.asarray(status, dtype=jnp.int32))
+
+
+# A system is a pure function advancing the registered world slice by one
+# frame given this frame's inputs. The reference analog is one Bevy system in
+# the user's rollback schedule (e.g. move_cube_system, box_game.rs:154-203).
+System = Callable[[WorldState, PlayerInputs], WorldState]
+
+
+class Schedule:
+    """An ordered composition of systems = one simulated frame.
+
+    ``schedule(state, inputs)`` is pure and jit-safe; the session drivers scan
+    it over frames and vmap it over speculative branches.
+    """
+
+    def __init__(self, systems: Sequence[System] = ()):
+        self._systems = list(systems)
+
+    def add_system(self, system: System) -> "Schedule":
+        self._systems.append(system)
+        return self
+
+    @property
+    def systems(self) -> Tuple[System, ...]:
+        return tuple(self._systems)
+
+    def __call__(self, state: WorldState, inputs: PlayerInputs) -> WorldState:
+        for system in self._systems:
+            state = system(state, inputs)
+        return state

@@ -1,0 +1,35 @@
+"""Session protocol layer (L1′): a from-scratch reimplementation of the GGRS
+session semantics the reference consumes (survey §2.2 contract table;
+`/root/reference/src/ggrs_stage.rs:3-6` imports).
+
+Three session flavors, matching ``SessionType`` (`src/lib.rs:25-36`):
+
+- :class:`~bevy_ggrs_tpu.session.synctest.SyncTestSession` — all players
+  local; forces a ``check_distance``-deep rollback every frame and compares
+  checksums (the determinism harness).
+- :class:`~bevy_ggrs_tpu.session.p2p.P2PSession` — UDP/loopback peers,
+  input prediction, rollback on misprediction, PredictionThreshold
+  back-pressure, time-sync pacing.
+- :class:`~bevy_ggrs_tpu.session.spectator.SpectatorSession` — receives
+  confirmed inputs from a host; never rolls back.
+
+All sessions speak the same request protocol: ``advance_frame()`` returns an
+ordered list of Save/Load/Advance requests the driver must execute
+(``GGRSRequest``, consumed at ``ggrs_stage.rs:259-269``).
+"""
+
+from bevy_ggrs_tpu.session.common import (
+    EventKind,
+    GGRSError,
+    InvalidRequest,
+    MismatchedChecksum,
+    NetworkStats,
+    NotSynchronized,
+    PredictionThreshold,
+    SessionEvent,
+    SessionState,
+    NULL_FRAME,
+)
+from bevy_ggrs_tpu.session.requests import AdvanceFrame, LoadGameState, SaveGameState
+from bevy_ggrs_tpu.session.input_queue import InputQueue
+from bevy_ggrs_tpu.session.synctest import SyncTestSession

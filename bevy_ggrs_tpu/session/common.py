@@ -1,0 +1,89 @@
+"""Shared session vocabulary: states, errors, events.
+
+Analog of the ggrs crate's public error/event/state types as consumed by the
+reference (`/root/reference/src/ggrs_stage.rs:202,244` gates on
+``SessionState::Running``; ``:205,251`` matches ``GGRSError::
+PredictionThreshold``; events pumped at `examples/box_game/box_game_p2p.rs:
+107-111`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional
+
+NULL_FRAME = -1
+
+
+class SessionState(enum.Enum):
+    """`SessionState` analog: sessions start Synchronizing and only advance
+    once Running (`ggrs_stage.rs:202,244`)."""
+
+    SYNCHRONIZING = "synchronizing"
+    RUNNING = "running"
+
+
+class GGRSError(Exception):
+    """Base session error."""
+
+
+class PredictionThreshold(GGRSError):
+    """Too far ahead of the last confirmed input — the caller must skip this
+    frame and retry later (back-pressure; `ggrs_stage.rs:251-253` logs and
+    skips, spectators wait for the host `:205-207`)."""
+
+
+class NotSynchronized(GGRSError):
+    """Session is still synchronizing with remotes (or spectator has no host
+    data yet)."""
+
+
+class InvalidRequest(GGRSError):
+    """API misuse: wrong handle, wrong input count, duplicate add_input."""
+
+
+class MismatchedChecksum(GGRSError):
+    """SyncTest: a resimulated frame produced a different checksum than the
+    original simulation — determinism is broken (desync)."""
+
+    def __init__(self, frame: int, original: int, resimulated: int):
+        super().__init__(
+            f"desync at frame {frame}: original checksum {original:#010x}, "
+            f"resimulated {resimulated:#010x}"
+        )
+        self.frame = frame
+        self.original = original
+        self.resimulated = resimulated
+
+
+class EventKind(enum.Enum):
+    """Session events the app can pump, mirroring ggrs's event enum as
+    printed by the reference examples (`box_game_p2p.rs:107-111`)."""
+
+    SYNCHRONIZING = "synchronizing"  # progress: (count, total)
+    SYNCHRONIZED = "synchronized"
+    DISCONNECTED = "disconnected"
+    NETWORK_INTERRUPTED = "network_interrupted"  # disconnect_timeout imminent
+    NETWORK_RESUMED = "network_resumed"
+    WAIT_RECOMMENDATION = "wait_recommendation"  # skip frames to let peers catch up
+    DESYNC_DETECTED = "desync_detected"
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionEvent:
+    kind: EventKind
+    addr: Optional[Any] = None  # peer address, where applicable
+    data: Optional[Any] = None  # kind-specific payload
+
+
+@dataclasses.dataclass
+class NetworkStats:
+    """Per-remote-player stats (`network_stats(handle)` consumed at
+    `box_game_p2p.rs:113-129`)."""
+
+    ping_ms: float = 0.0
+    send_queue_len: int = 0
+    kbps_sent: float = 0.0
+    local_frames_behind: int = 0
+    remote_frames_behind: int = 0

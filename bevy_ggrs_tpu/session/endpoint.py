@@ -1,0 +1,251 @@
+"""Per-peer connection state machine (the ggrs UdpProtocol analog).
+
+One :class:`PeerEndpoint` per remote address. Owns the sync handshake
+(nonce-echo roundtrips before the session reports Running —
+`/root/reference/src/ggrs_stage.rs:202,244` gates on that), pending-output
+input spans with redundant resend until acked, ping measurement via
+quality report/reply, frame-advantage exchange for time sync, keepalives,
+and disconnect detection with the interrupt/resume event pair the reference
+examples print (`examples/box_game/box_game_p2p.rs:107-111`).
+
+All timing flows through an explicit ``now`` (seconds) so the loopback
+transport's virtual clock drives everything deterministically in tests.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bevy_ggrs_tpu.session import protocol as proto
+from bevy_ggrs_tpu.session.common import (
+    EventKind,
+    NetworkStats,
+    SessionEvent,
+    NULL_FRAME,
+)
+
+NUM_SYNC_ROUNDTRIPS = 5
+SYNC_RETRY_INTERVAL = 0.2
+QUALITY_REPORT_INTERVAL = 0.2
+KEEP_ALIVE_INTERVAL = 0.2
+CHECKSUM_REPORT_INTERVAL_FRAMES = 16
+DEFAULT_DISCONNECT_TIMEOUT = 2.0
+DEFAULT_DISCONNECT_NOTIFY_START = 0.5
+
+
+class PeerState(enum.Enum):
+    SYNCHRONIZING = "synchronizing"
+    RUNNING = "running"
+    DISCONNECTED = "disconnected"
+
+
+class PeerEndpoint:
+    def __init__(
+        self,
+        addr,
+        rng: np.random.RandomState,
+        disconnect_timeout: float = DEFAULT_DISCONNECT_TIMEOUT,
+        disconnect_notify_start: float = DEFAULT_DISCONNECT_NOTIFY_START,
+    ):
+        self.addr = addr
+        self.state = PeerState.SYNCHRONIZING
+        self._rng = rng
+        self.disconnect_timeout = disconnect_timeout
+        self.disconnect_notify_start = disconnect_notify_start
+
+        self._sync_remaining = NUM_SYNC_ROUNDTRIPS
+        self._sync_nonce: Optional[int] = None
+        self._last_sync_sent = -1e9
+
+        # Outgoing input spans, per local handle: frame -> bits (unacked).
+        self._pending_output: Dict[int, Dict[int, np.ndarray]] = {}
+
+        self._last_recv = 0.0
+        self._last_send = -1e9
+        self._last_quality_sent = -1e9
+        self._interrupted = False
+
+        self.ping_ms = 0.0
+        self.remote_frame = NULL_FRAME
+        self.remote_advantage = 0  # peer's own advantage estimate, in frames
+        self.bytes_sent = 0
+        self._send_window: List[Tuple[float, int]] = []  # (time, nbytes)
+
+        self.outbox: List[bytes] = []
+        self.events: List[SessionEvent] = []
+
+        # Remote checksum reports for desync detection: frame -> checksum.
+        self.remote_checksums: Dict[int, int] = {}
+
+    # ------------------------------------------------------------------
+
+    def _emit(self, kind: EventKind, data=None) -> None:
+        self.events.append(SessionEvent(kind, addr=self.addr, data=data))
+
+    def _send(self, msg: proto.Message, now: float) -> None:
+        data = proto.encode(msg)
+        self.outbox.append(data)
+        self.bytes_sent += len(data)
+        self._send_window.append((now, len(data)))
+        if len(self._send_window) > 4096:  # bound even if stats() never runs
+            self._send_window = [
+                (t, n) for t, n in self._send_window if now - t <= 2.0
+            ]
+        self._last_send = now
+
+    # ------------------------------------------------------------------
+
+    def poll(self, now: float, local_frame: int, local_advantage: int) -> None:
+        """Drive timers: sync retries, quality reports, keepalives,
+        disconnect detection."""
+        if self.state == PeerState.SYNCHRONIZING:
+            if now - self._last_sync_sent >= SYNC_RETRY_INTERVAL:
+                self._sync_nonce = int(self._rng.randint(0, 2**31))
+                self._send(proto.SyncRequest(self._sync_nonce), now)
+                self._last_sync_sent = now
+            return
+        if self.state == PeerState.DISCONNECTED:
+            return
+
+        idle = now - self._last_recv
+        if idle > self.disconnect_timeout:
+            self.state = PeerState.DISCONNECTED
+            self._emit(EventKind.DISCONNECTED)
+            return
+        if idle > self.disconnect_notify_start and not self._interrupted:
+            self._interrupted = True
+            self._emit(
+                EventKind.NETWORK_INTERRUPTED,
+                data={"disconnect_timeout": self.disconnect_timeout},
+            )
+
+        if now - self._last_quality_sent >= QUALITY_REPORT_INTERVAL:
+            self._send(
+                proto.QualityReport(int(now * 1000) & 0xFFFFFFFF, local_advantage),
+                now,
+            )
+            self._last_quality_sent = now
+        if now - self._last_send >= KEEP_ALIVE_INTERVAL:
+            self._send(proto.KeepAlive(), now)
+
+    # ------------------------------------------------------------------
+
+    def on_message(
+        self,
+        msg: proto.Message,
+        now: float,
+        on_inputs: Callable[[proto.InputMsg], None],
+    ) -> None:
+        self._last_recv = now
+        if self._interrupted and self.state == PeerState.RUNNING:
+            self._interrupted = False
+            self._emit(EventKind.NETWORK_RESUMED)
+
+        if isinstance(msg, proto.SyncRequest):
+            self._send(proto.SyncReply(msg.nonce), now)
+        elif isinstance(msg, proto.SyncReply):
+            if (
+                self.state == PeerState.SYNCHRONIZING
+                and msg.nonce == self._sync_nonce
+            ):
+                self._sync_remaining -= 1
+                self._last_sync_sent = -1e9  # send next roundtrip immediately
+                if self._sync_remaining <= 0:
+                    self.state = PeerState.RUNNING
+                    self._last_recv = now
+                    self._emit(EventKind.SYNCHRONIZED)
+                else:
+                    self._emit(
+                        EventKind.SYNCHRONIZING,
+                        data={
+                            "count": NUM_SYNC_ROUNDTRIPS - self._sync_remaining,
+                            "total": NUM_SYNC_ROUNDTRIPS,
+                        },
+                    )
+        elif isinstance(msg, proto.InputMsg):
+            self.remote_frame = max(self.remote_frame, msg.sender_frame)
+            self.remote_advantage = msg.advantage
+            for h in list(self._pending_output):
+                self._ack(h, msg.ack_frame)
+            on_inputs(msg)
+        elif isinstance(msg, proto.InputAck):
+            self._ack(msg.handle, msg.ack_frame)
+        elif isinstance(msg, proto.QualityReport):
+            self.remote_advantage = msg.frame_advantage
+            self._send(proto.QualityReply(msg.send_time_ms), now)
+        elif isinstance(msg, proto.QualityReply):
+            rtt = (int(now * 1000) & 0xFFFFFFFF) - msg.pong_time_ms
+            if rtt >= 0:
+                self.ping_ms = 0.8 * self.ping_ms + 0.2 * rtt if self.ping_ms else rtt
+        elif isinstance(msg, proto.ChecksumReport):
+            self.remote_checksums[msg.frame] = msg.checksum
+            if len(self.remote_checksums) > 64:
+                for f in sorted(self.remote_checksums)[:-64]:
+                    del self.remote_checksums[f]
+        # KeepAlive: nothing beyond the last_recv bump.
+
+    def _ack(self, handle: int, ack_frame: int) -> None:
+        pending = self._pending_output.get(handle)
+        if pending is None:
+            return
+        for f in [f for f in pending if f <= ack_frame]:
+            del pending[f]
+
+    # ------------------------------------------------------------------
+
+    def queue_input(self, handle: int, frame: int, bits: np.ndarray) -> None:
+        self._pending_output.setdefault(handle, {})[frame] = np.asarray(bits)
+
+    def send_pending_inputs(
+        self, now: float, local_frame: int, local_advantage: int, ack_frame: int
+    ) -> None:
+        """One InputMsg per local handle carrying every unacked frame —
+        the redundancy that makes the protocol loss-tolerant without
+        retransmit timers."""
+        if self.state != PeerState.RUNNING:
+            return
+        for handle, pending in self._pending_output.items():
+            if not pending:
+                continue
+            frames = sorted(pending)
+            span = [(f, pending[f]) for f in frames]
+            start, num, payload = proto.pack_input_span(span)
+            self._send(
+                proto.InputMsg(
+                    handle=handle,
+                    start_frame=start,
+                    payload=payload,
+                    num=num,
+                    ack_frame=ack_frame,
+                    sender_frame=local_frame,
+                    advantage=local_advantage,
+                ),
+                now,
+            )
+
+    def send_input_ack(self, handle: int, ack_frame: int, now: float) -> None:
+        self._send(proto.InputAck(handle, ack_frame), now)
+
+    def send_checksum(self, frame: int, checksum: int, now: float) -> None:
+        self._send(proto.ChecksumReport(frame, checksum), now)
+
+    # ------------------------------------------------------------------
+
+    def stats(self, now: float, local_frame: int) -> NetworkStats:
+        window = [(t, n) for t, n in self._send_window if now - t <= 2.0]
+        self._send_window = window
+        kbps = sum(n for _, n in window) * 8 / 1000.0 / max(
+            min(2.0, now - window[0][0]) if window else 1.0, 1e-3
+        )
+        return NetworkStats(
+            ping_ms=self.ping_ms,
+            send_queue_len=max(
+                (len(p) for p in self._pending_output.values()), default=0
+            ),
+            kbps_sent=kbps,
+            local_frames_behind=self.remote_frame - local_frame,
+            remote_frames_behind=self.remote_advantage,
+        )

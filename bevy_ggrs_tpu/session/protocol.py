@@ -1,0 +1,212 @@
+"""Wire protocol: the peer-to-peer message vocabulary.
+
+Reimplements the *semantics* of the ggrs UDP protocol the reference rides
+(survey §2.2): sync handshake with nonce echo, input spans with redundancy
+(every packet resends all unacked frames, so loss tolerance needs no
+retransmit timer), acks, quality (ping/frame-advantage) exchange, keepalives,
+and periodic confirmed-frame checksum reports for desync detection.
+
+Encoding is a hand-rolled little-endian struct format (one magic/version
+header byte pair + type byte), small enough to stay well under one MTU for
+any plausible input size × redundancy span.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+
+MAGIC = 0x47  # 'G'
+VERSION = 1
+
+T_SYNC_REQUEST = 1
+T_SYNC_REPLY = 2
+T_INPUT = 3
+T_INPUT_ACK = 4
+T_QUALITY_REPORT = 5
+T_QUALITY_REPLY = 6
+T_KEEP_ALIVE = 7
+T_CHECKSUM_REPORT = 8
+
+_HDR = struct.Struct("<BBB")  # magic, version, type
+
+
+@dataclasses.dataclass(frozen=True)
+class SyncRequest:
+    nonce: int
+
+
+@dataclasses.dataclass(frozen=True)
+class SyncReply:
+    nonce: int
+
+
+@dataclasses.dataclass(frozen=True)
+class InputMsg:
+    """A span of inputs for one player: frames ``start_frame ..
+    start_frame+num-1`` (redundant resend of everything unacked).
+    ``ack_frame`` acks the receiver's inputs; ``sender_frame`` and
+    ``advantage`` feed time sync."""
+
+    handle: int
+    start_frame: int
+    payload: bytes  # num × input_size raw bytes
+    num: int
+    ack_frame: int
+    sender_frame: int
+    advantage: int  # sender's local frame advantage estimate (frames)
+
+    _FMT = struct.Struct("<BiHHiih")
+
+    def encode(self) -> bytes:
+        return (
+            self._FMT.pack(
+                self.handle,
+                self.start_frame,
+                self.num,
+                len(self.payload) // max(self.num, 1),
+                self.ack_frame,
+                self.sender_frame,
+                self.advantage,
+            )
+            + self.payload
+        )
+
+    @classmethod
+    def decode(cls, body: bytes) -> "InputMsg":
+        handle, start, num, size, ack, sender, adv = cls._FMT.unpack_from(body)
+        payload = body[cls._FMT.size : cls._FMT.size + num * size]
+        return cls(handle, start, payload, num, ack, sender, adv)
+
+
+@dataclasses.dataclass(frozen=True)
+class InputAck:
+    handle: int
+    ack_frame: int
+
+
+@dataclasses.dataclass(frozen=True)
+class QualityReport:
+    send_time_ms: int  # sender clock, ms, wraps at 2^32
+    frame_advantage: int
+
+
+@dataclasses.dataclass(frozen=True)
+class QualityReply:
+    pong_time_ms: int
+
+
+@dataclasses.dataclass(frozen=True)
+class KeepAlive:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ChecksumReport:
+    frame: int
+    checksum: int
+
+
+Message = Union[
+    SyncRequest, SyncReply, InputMsg, InputAck, QualityReport, QualityReply,
+    KeepAlive, ChecksumReport,
+]
+
+_U32 = struct.Struct("<I")
+_I32U32 = struct.Struct("<iI")
+_BI = struct.Struct("<Bi")
+_IH = struct.Struct("<Ih")
+
+
+def encode(msg: Message) -> bytes:
+    if isinstance(msg, SyncRequest):
+        return _HDR.pack(MAGIC, VERSION, T_SYNC_REQUEST) + _U32.pack(msg.nonce)
+    if isinstance(msg, SyncReply):
+        return _HDR.pack(MAGIC, VERSION, T_SYNC_REPLY) + _U32.pack(msg.nonce)
+    if isinstance(msg, InputMsg):
+        return _HDR.pack(MAGIC, VERSION, T_INPUT) + msg.encode()
+    if isinstance(msg, InputAck):
+        return _HDR.pack(MAGIC, VERSION, T_INPUT_ACK) + _BI.pack(
+            msg.handle, msg.ack_frame
+        )
+    if isinstance(msg, QualityReport):
+        return _HDR.pack(MAGIC, VERSION, T_QUALITY_REPORT) + _IH.pack(
+            msg.send_time_ms & 0xFFFFFFFF, msg.frame_advantage
+        )
+    if isinstance(msg, QualityReply):
+        return _HDR.pack(MAGIC, VERSION, T_QUALITY_REPLY) + _U32.pack(
+            msg.pong_time_ms & 0xFFFFFFFF
+        )
+    if isinstance(msg, KeepAlive):
+        return _HDR.pack(MAGIC, VERSION, T_KEEP_ALIVE)
+    if isinstance(msg, ChecksumReport):
+        return _HDR.pack(MAGIC, VERSION, T_CHECKSUM_REPORT) + _I32U32.pack(
+            msg.frame, msg.checksum & 0xFFFFFFFF
+        )
+    raise TypeError(f"unknown message {msg!r}")
+
+
+def decode(data: bytes) -> Optional[Message]:
+    """Parse one datagram; returns None for garbage / version mismatch
+    (untrusted network input — never raise)."""
+    try:
+        if len(data) < _HDR.size:
+            return None
+        magic, version, mtype = _HDR.unpack_from(data)
+        if magic != MAGIC or version != VERSION:
+            return None
+        body = data[_HDR.size :]
+        if mtype == T_SYNC_REQUEST:
+            return SyncRequest(_U32.unpack_from(body)[0])
+        if mtype == T_SYNC_REPLY:
+            return SyncReply(_U32.unpack_from(body)[0])
+        if mtype == T_INPUT:
+            return InputMsg.decode(body)
+        if mtype == T_INPUT_ACK:
+            h, f = _BI.unpack_from(body)
+            return InputAck(h, f)
+        if mtype == T_QUALITY_REPORT:
+            t, adv = _IH.unpack_from(body)
+            return QualityReport(t, adv)
+        if mtype == T_QUALITY_REPLY:
+            return QualityReply(_U32.unpack_from(body)[0])
+        if mtype == T_KEEP_ALIVE:
+            return KeepAlive()
+        if mtype == T_CHECKSUM_REPORT:
+            f, cs = _I32U32.unpack_from(body)
+            return ChecksumReport(f, cs)
+        return None
+    except struct.error:
+        return None
+
+
+def pack_input_span(
+    frames_bits: List[Tuple[int, np.ndarray]],
+) -> Tuple[int, int, bytes]:
+    """Pack a contiguous ascending span of (frame, bits) into
+    (start_frame, num, payload)."""
+    if not frames_bits:
+        return 0, 0, b""
+    start = frames_bits[0][0]
+    payload = b"".join(np.ascontiguousarray(b).tobytes() for _, b in frames_bits)
+    return start, len(frames_bits), payload
+
+
+def unpack_input_span(
+    msg: InputMsg, dtype: np.dtype, shape: Tuple[int, ...]
+) -> List[Tuple[int, np.ndarray]]:
+    """Inverse of :func:`pack_input_span` for a known input spec."""
+    if msg.num == 0:
+        return []
+    itemsize = int(np.dtype(dtype).itemsize * int(np.prod(shape, dtype=int) or 1))
+    out = []
+    for i in range(msg.num):
+        chunk = msg.payload[i * itemsize : (i + 1) * itemsize]
+        if len(chunk) < itemsize:
+            break
+        arr = np.frombuffer(chunk, dtype=dtype).reshape(shape)
+        out.append((msg.start_frame + i, arr))
+    return out

@@ -1,0 +1,113 @@
+"""SyncTestSession: the determinism harness.
+
+All players are local. Every ``advance_frame`` first takes the normal
+(save, advance) step, then — once ``check_distance`` frames of history exist
+— emits a forced rollback ``check_distance`` frames deep and resimulates up
+to the present with the *same* stored inputs. When the driver re-saves each
+resimulated frame, the session compares the new checksum against the one
+recorded on the original pass; any mismatch raises
+:class:`MismatchedChecksum` — the simulate-vs-resimulate property check the
+reference runs continuously (`/root/reference/examples/box_game/
+box_game_synctest.rs:27-38`; driven by `src/ggrs_stage.rs:163-193`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from bevy_ggrs_tpu.schedule import CONFIRMED, InputSpec
+from bevy_ggrs_tpu.session.common import (
+    InvalidRequest,
+    MismatchedChecksum,
+    SessionState,
+)
+from bevy_ggrs_tpu.session.input_queue import InputQueue
+from bevy_ggrs_tpu.session.requests import AdvanceFrame, LoadGameState, SaveGameState
+
+
+class SyncTestSession:
+    def __init__(
+        self,
+        num_players: int,
+        input_spec: InputSpec = InputSpec(),
+        check_distance: int = 2,
+        max_prediction: int = 8,
+        input_delay: int = 0,
+    ):
+        if check_distance > max_prediction:
+            raise InvalidRequest(
+                f"check_distance {check_distance} exceeds max_prediction "
+                f"{max_prediction}"
+            )
+        self.num_players = int(num_players)
+        self.input_spec = input_spec
+        self.check_distance = int(check_distance)
+        self.max_prediction = int(max_prediction)
+        self.current_frame = 0
+        zero = input_spec.zeros_np(1)[0]
+        self._queues = [InputQueue(zero, input_delay) for _ in range(num_players)]
+        self._pending: Dict[int, np.ndarray] = {}
+        self._checksums: Dict[int, int] = {}
+
+    # -- API parity with the stage driver's session usage ------------------
+
+    def current_state(self) -> SessionState:
+        return SessionState.RUNNING  # synctest never synchronizes
+
+    def local_player_handles(self) -> List[int]:
+        return list(range(self.num_players))
+
+    def add_local_input(self, handle: int, bits) -> None:
+        """Collect this frame's input for ``handle``
+        (`ggrs_stage.rs:186`)."""
+        if not 0 <= handle < self.num_players:
+            raise InvalidRequest(f"invalid player handle {handle}")
+        self._pending[handle] = np.asarray(bits)
+
+    def advance_frame(self) -> List[object]:
+        """Emit the request list for one simulated frame: the normal step,
+        plus the forced rollback+resimulation once history allows."""
+        if set(self._pending) != set(range(self.num_players)):
+            missing = set(range(self.num_players)) - set(self._pending)
+            raise InvalidRequest(f"missing local input for handles {sorted(missing)}")
+        frame = self.current_frame
+        for h, q in enumerate(self._queues):
+            q.add_local_input(frame, self._pending[h])
+        self._pending.clear()
+
+        requests: List[object] = [
+            SaveGameState(frame),
+            self._advance_request(frame),
+        ]
+        if self.check_distance > 0 and frame >= self.check_distance:
+            load_frame = frame - self.check_distance
+            requests.append(LoadGameState(load_frame))
+            for f in range(load_frame, frame + 1):
+                requests.append(SaveGameState(f))
+                requests.append(self._advance_request(f))
+        self.current_frame = frame + 1
+        # GC: inputs/checksums older than the deepest future rollback.
+        horizon = self.current_frame - self.check_distance - 1
+        for q in self._queues:
+            q.discard_before(horizon)
+        for f in [f for f in self._checksums if f < horizon]:
+            del self._checksums[f]
+        return requests
+
+    def _advance_request(self, frame: int) -> AdvanceFrame:
+        bits = np.stack([q.input(frame)[0] for q in self._queues])
+        status = np.full((self.num_players,), CONFIRMED, dtype=np.int32)
+        return AdvanceFrame(bits=bits, status=status)
+
+    def report_checksum(self, frame: int, checksum: int) -> None:
+        """The ``GameStateCell::save`` analog (`ggrs_stage.rs:282-283`): the
+        driver reports each saved frame's checksum; a resimulated frame that
+        hashes differently than its original save is a desync."""
+        checksum = int(checksum)
+        prev = self._checksums.get(frame)
+        if prev is None:
+            self._checksums[frame] = checksum
+        elif prev != checksum:
+            raise MismatchedChecksum(frame, prev, checksum)

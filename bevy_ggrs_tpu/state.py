@@ -326,10 +326,10 @@ def checksum(state: WorldState) -> jnp.ndarray:
         leaves = jax.tree_util.tree_leaves(state.resources[name])
         # Seed with the full name so same-length-named resources can't swap
         # values undetected.
-        name_seed = np.uint32(0)
+        name_seed = 0
         for b in name.encode():
-            name_seed = (name_seed * np.uint32(31) + np.uint32(b)) & np.uint32(0xFFFFFFFF)
-        rh = jnp.full((1,), _SEED ^ name_seed, dtype=jnp.uint32)
+            name_seed = (name_seed * 31 + b) & 0xFFFFFFFF
+        rh = jnp.full((1,), _SEED ^ np.uint32(name_seed), dtype=jnp.uint32)
         for leaf in leaves:
             words = _to_u32_words(jnp.atleast_1d(leaf).reshape(1, -1))
             rh = _mix_words(rh, words)

@@ -1,0 +1,20 @@
+"""Transport layer (L0′): non-blocking datagram sockets for peer I/O.
+
+The reference rides ggrs's ``NonBlockingSocket`` trait with a UDP
+implementation (`/root/reference/examples/box_game/box_game_p2p.rs:57`
+``UdpNonBlockingSocket::bind_to_port``). Peer traffic is tiny (input bitmasks
++ protocol chatter) and latency-bound, so it stays on the host CPU — the
+wrong shape for ICI (survey §2.4). Implementations:
+
+- :class:`UdpSocket` — real UDP, non-blocking, for actual multi-host play.
+- :class:`LoopbackNetwork` / :class:`LoopbackSocket` — deterministic
+  in-memory transport with virtual time, configurable latency, jitter, and
+  seeded packet loss: the injection seam the reference lacks (survey §4
+  explicitly calls for it) enabling multi-peer tests in one process.
+- A native C++ batched UDP poller (``bevy_ggrs_tpu/native``) slots in behind
+  the same interface when built.
+"""
+
+from bevy_ggrs_tpu.transport.socket import NonBlockingSocket
+from bevy_ggrs_tpu.transport.loopback import LoopbackNetwork, LoopbackSocket
+from bevy_ggrs_tpu.transport.udp import UdpSocket

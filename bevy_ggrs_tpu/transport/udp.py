@@ -1,0 +1,63 @@
+"""Non-blocking UDP socket (``UdpNonBlockingSocket`` analog,
+`/root/reference/examples/box_game/box_game_p2p.rs:57`).
+
+If the native C++ poller (``bevy_ggrs_tpu.native``) is built, it is used for
+the drain loop (one ``recvmmsg`` batch per poll instead of one Python
+``recvfrom`` syscall per datagram); otherwise pure-Python sockets serve.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import List, Optional, Tuple
+
+_MAX_DGRAM = 65536
+
+
+class UdpSocket:
+    def __init__(self, port: int, host: str = "0.0.0.0", use_native: bool = True):
+        self._native = None
+        if use_native:
+            try:
+                from bevy_ggrs_tpu.native import udp as native_udp
+
+                self._native = native_udp.NativeUdpSocket(host, port)
+            except Exception:
+                self._native = None
+        if self._native is None:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self._sock.setblocking(False)
+            self._sock.bind((host, port))
+
+    @classmethod
+    def bind_to_port(cls, port: int) -> "UdpSocket":
+        return cls(port)
+
+    def send_to(self, msg: bytes, addr: Tuple[str, int]) -> None:
+        if self._native is not None:
+            self._native.send_to(msg, addr)
+            return
+        try:
+            self._sock.sendto(msg, addr)
+        except (BlockingIOError, InterruptedError):
+            pass  # non-blocking contract: drop on transient backpressure
+
+    def receive_all(self) -> List[Tuple[Tuple[str, int], bytes]]:
+        if self._native is not None:
+            return self._native.receive_all()
+        out = []
+        while True:
+            try:
+                msg, addr = self._sock.recvfrom(_MAX_DGRAM)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                break
+            out.append((addr, msg))
+        return out
+
+    def close(self) -> None:
+        if self._native is not None:
+            self._native.close()
+        else:
+            self._sock.close()

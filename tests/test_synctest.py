@@ -1,0 +1,133 @@
+"""SyncTestSession end-to-end: the minimum slice of the survey's build plan
+(§7 step 3) — box_game running under forced rollbacks with checksum
+comparison every frame, driven through the real request protocol and the
+fused device executor.
+
+Reference behavior: `examples/box_game/box_game_synctest.rs:27-38` +
+`src/ggrs_stage.rs:163-193`.
+"""
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu import checksum
+from bevy_ggrs_tpu.models import box_game
+from bevy_ggrs_tpu.runner import RollbackRunner
+from bevy_ggrs_tpu.schedule import make_inputs
+from bevy_ggrs_tpu.session import (
+    InvalidRequest,
+    MismatchedChecksum,
+    SyncTestSession,
+)
+from bevy_ggrs_tpu.session.requests import AdvanceFrame, LoadGameState, SaveGameState
+
+
+def make(num_players=2, check_distance=2, input_delay=0, max_prediction=8):
+    session = SyncTestSession(
+        num_players,
+        box_game.INPUT_SPEC,
+        check_distance=check_distance,
+        max_prediction=max_prediction,
+        input_delay=input_delay,
+    )
+    runner = RollbackRunner(
+        box_game.make_schedule(),
+        box_game.make_world(num_players).commit(),
+        max_prediction=max_prediction,
+        num_players=num_players,
+        input_spec=box_game.INPUT_SPEC,
+    )
+    return session, runner
+
+
+def tick(session, runner, bits):
+    for h in range(session.num_players):
+        session.add_local_input(h, bits[h])
+    runner.handle_requests(session.advance_frame(), session)
+
+
+def test_request_shape_before_and_after_check_distance():
+    session, _ = make(check_distance=2)
+    for h in range(2):
+        session.add_local_input(h, np.uint8(0))
+    reqs = session.advance_frame()
+    # Frame 0: no history yet → plain [Save, Advance].
+    assert [type(r) for r in reqs] == [SaveGameState, AdvanceFrame]
+    for _ in range(2):
+        for h in range(2):
+            session.add_local_input(h, np.uint8(0))
+        reqs = session.advance_frame()
+    # Frame 2: forced rollback 2 deep → Save, Advance, Load(0), then 3
+    # (Save, Advance) pairs replaying frames 0..2.
+    kinds = [type(r) for r in reqs]
+    assert kinds == [SaveGameState, AdvanceFrame, LoadGameState] + [
+        SaveGameState, AdvanceFrame] * 3
+    assert reqs[2].frame == 0
+
+
+def test_synctest_deterministic_game_runs_clean():
+    session, runner = make(num_players=2, check_distance=3)
+    rng = np.random.RandomState(0)
+    for _ in range(30):
+        tick(session, runner, rng.randint(0, 16, size=2).astype(np.uint8))
+    assert runner.frame == 30
+    assert runner.rollbacks_total > 0  # forced rollbacks actually happened
+    assert int(runner.state.resources["frame_count"]) == 30
+
+
+def test_synctest_matches_straightline_simulation():
+    """After N frames with rollbacks forced every frame, state must equal a
+    straight single-pass simulation of the same inputs."""
+    session, runner = make(num_players=2, check_distance=4)
+    sched = box_game.make_schedule()
+    oracle = box_game.make_world(2).commit()
+    rng = np.random.RandomState(1)
+    for _ in range(20):
+        bits = rng.randint(0, 16, size=2).astype(np.uint8)
+        tick(session, runner, bits)
+        oracle = sched(oracle, make_inputs(bits))
+    assert int(checksum(runner.state)) == int(checksum(oracle))
+
+
+def test_synctest_detects_nondeterminism():
+    """State mutated outside the rollback domain (bypassing the snapshot
+    ring) must trip MismatchedChecksum on a later resimulation — the desync
+    class the harness exists to catch (reference
+    `examples/README.md:13-18`)."""
+    session, runner = make(num_players=2, check_distance=2)
+    tick(session, runner, np.zeros(2, np.uint8))
+    # Out-of-band tamper: live state drifts, ring snapshots don't know.
+    runner.state = runner.state.replace(
+        components={
+            **runner.state.components,
+            "translation": runner.state.components["translation"] + 0.001,
+        }
+    )
+    with pytest.raises(MismatchedChecksum):
+        for _ in range(5):
+            tick(session, runner, np.zeros(2, np.uint8))
+
+
+def test_input_delay_shifts_effect():
+    """With input_delay=2, an input issued at frame f takes effect at f+2
+    (`with_input_delay`, box_game_p2p.rs:37)."""
+    session, runner = make(num_players=1, check_distance=0, input_delay=2)
+    tick(session, runner, np.array([box_game.INPUT_RIGHT], np.uint8))
+    v_after_f0 = runner.world()["components"]["velocity"][0]
+    assert v_after_f0[0] == 0.0  # delayed input not yet in effect
+    tick(session, runner, np.zeros(1, np.uint8))
+    tick(session, runner, np.zeros(1, np.uint8))
+    v_after_f2 = runner.world()["components"]["velocity"][0]
+    assert v_after_f2[0] > 0.0  # now it landed
+
+
+def test_missing_input_rejected():
+    session, _ = make(num_players=2)
+    session.add_local_input(0, np.uint8(0))
+    with pytest.raises(InvalidRequest):
+        session.advance_frame()
+
+
+def test_check_distance_beyond_prediction_rejected():
+    with pytest.raises(InvalidRequest):
+        SyncTestSession(2, box_game.INPUT_SPEC, check_distance=9, max_prediction=8)
