@@ -1,0 +1,71 @@
+"""Headline benchmark: box_game speculative rollback rollout.
+
+Target (BASELINE.md): resimulate 8 rollback frames × 256 speculative input
+branches for box_game inside one 60 Hz render frame (<16 ms) on a single TPU
+chip. The reference executes the same recovery serially on host CPU — up to
+``max_prediction`` × (restore + full schedule run) per render frame
+(`/root/reference/src/ggrs_stage.rs:259-269`).
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}``
+where ``vs_baseline`` > 1 means faster than the 16 ms budget.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+FRAMES = 8
+BRANCHES = 256
+PLAYERS = 2
+BUDGET_MS = 16.0
+
+
+def main() -> None:
+    from bevy_ggrs_tpu.models import box_game
+    from bevy_ggrs_tpu.parallel.speculate import (
+        SpeculativeExecutor,
+        bitmask_sampler,
+        enumerate_branches,
+    )
+
+    schedule = box_game.make_schedule()
+    state = box_game.make_world(PLAYERS).commit()
+    ex = SpeculativeExecutor(schedule, BRANCHES, FRAMES)
+    key = jax.random.PRNGKey(0)
+    bits = enumerate_branches(
+        key, jnp.zeros((PLAYERS,), jnp.uint8), BRANCHES, FRAMES,
+        sampler=bitmask_sampler(),
+    )
+    bits = jax.block_until_ready(bits)
+
+    # Warmup / compile.
+    result = ex.run(state, 0, bits)
+    jax.block_until_ready((result.rings, result.states, result.checksums))
+
+    times = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        result = ex.run(state, 0, bits)
+        jax.block_until_ready((result.rings, result.states, result.checksums))
+        times.append((time.perf_counter() - t0) * 1000.0)
+    ms = float(np.median(times))
+    print(
+        json.dumps(
+            {
+                "metric": f"box_game_rollback_{FRAMES}f_x_{BRANCHES}b_latency",
+                "value": round(ms, 3),
+                "unit": "ms",
+                "vs_baseline": round(BUDGET_MS / ms, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

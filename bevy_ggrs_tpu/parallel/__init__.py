@@ -1,0 +1,17 @@
+"""Parallelism layer: speculative branch batching + device-mesh sharding.
+
+The reference's only parallelism is a host thread pool inside one simulated
+frame (`/root/reference/examples/box_game/box_game_p2p.rs:74`) — speculation
+(frames beyond confirmed input) is *serial* replay (`src/ggrs_stage.rs:
+259-269`). Here speculation is a batch dimension: B candidate input branches
+× F frames evaluated as one vmapped, pjit-sharded rollout (survey §2.3's
+TPU-native mapping).
+"""
+
+from bevy_ggrs_tpu.parallel.speculate import (
+    BranchSampler,
+    SpeculativeExecutor,
+    enumerate_branches,
+    match_branch,
+)
+from bevy_ggrs_tpu.parallel.sharding import branch_mesh, shard_branch_axis

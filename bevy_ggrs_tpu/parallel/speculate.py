@@ -1,0 +1,291 @@
+"""Speculative branch batching: the serial replay loop, turned into a batch axis.
+
+The reference predicts remote inputs with exactly ONE hypothesis —
+repeat-last-input — and pays a serial ``max_prediction``-deep replay when it
+is wrong (`/root/reference/src/ggrs_stage.rs:259-269`; GGPO prediction policy
+per survey §2.2). On TPU the marginal cost of more hypotheses is ~zero:
+``vmap`` the fused rollout over B candidate input branches, shard the branch
+axis across the device mesh, and when real inputs arrive pick the branch
+whose prefix matches — misprediction recovery becomes a *select*, not a
+resimulation.
+
+Pipeline:
+
+1. :func:`enumerate_branches` — build the candidate input tensor
+   ``bits[B, F, P, …]``. Branch 0 is always the reference's own policy
+   (repeat last confirmed input), so the speculative engine strictly
+   dominates the reference: its prediction is one of ours.
+2. :class:`SpeculativeExecutor` — one jitted device call rolls every branch
+   forward F frames from the same start state, ring-saving each frame
+   per-branch and streaming per-branch-per-frame checksums.
+3. :func:`match_branch` — host-side: longest-prefix match of confirmed
+   inputs against the branch tensor.
+4. :meth:`SpeculativeExecutor.commit` — gather the matched branch's
+   ring/state (one cross-device gather when sharded) and merge its saved
+   frames into the session's main snapshot ring.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bevy_ggrs_tpu.schedule import PREDICTED, Schedule
+from bevy_ggrs_tpu.state import SnapshotRing, WorldState
+from bevy_ggrs_tpu.rollout import rollout_burst
+
+# A branch sampler maps (key, last_bits[P, …], B, F) -> bits[B, F, P, …]:
+# the Monte Carlo input tree (survey §7 "branch selection policy").
+BranchSampler = Callable[[jax.Array, jnp.ndarray, int, int], jnp.ndarray]
+
+
+def repeat_last_sampler(key, last_bits, num_branches: int, num_frames: int):
+    """Every branch repeats the last input — degenerate tree, reference
+    parity (all branches identical; useful as a baseline)."""
+    del key
+    return jnp.broadcast_to(
+        last_bits[None, None], (num_branches, num_frames) + last_bits.shape
+    )
+
+
+def bitmask_sampler(
+    num_bits: int = 4, keep_prob: float = 0.5
+) -> BranchSampler:
+    """Monte Carlo tree over ``u8``-bitmask inputs (box_game-style).
+
+    Per branch/frame/player: with ``keep_prob`` keep the previous frame's
+    input (players hold keys across frames far more often than not), else
+    draw a uniform random mask over the low ``num_bits`` bits. Branch 0 is
+    pinned to repeat-last so the engine always contains the reference's
+    prediction.
+    """
+
+    def sample(key, last_bits, num_branches: int, num_frames: int):
+        kk, km = jax.random.split(key)
+        shape = (num_branches, num_frames) + last_bits.shape
+        keep = jax.random.bernoulli(kk, keep_prob, shape)
+        rand = jax.random.randint(km, shape, 0, 1 << num_bits, dtype=jnp.int32)
+
+        def scan_frame(prev, xs):
+            k, r = xs  # [B, P...]
+            cur = jnp.where(k, prev, r.astype(last_bits.dtype))
+            return cur, cur
+
+        init = jnp.broadcast_to(last_bits, (num_branches,) + last_bits.shape)
+        _, bits = jax.lax.scan(
+            scan_frame, init, (jnp.moveaxis(keep, 1, 0), jnp.moveaxis(rand, 1, 0))
+        )
+        bits = jnp.moveaxis(bits, 0, 1)  # [B, F, P, …]
+        base = jnp.broadcast_to(
+            last_bits[None, None], (1, num_frames) + last_bits.shape
+        ).astype(last_bits.dtype)
+        return jnp.concatenate([base, bits[1:]], axis=0)
+
+    return sample
+
+
+def enumerate_branches(
+    key,
+    last_bits,
+    num_branches: int,
+    num_frames: int,
+    sampler: Optional[BranchSampler] = None,
+) -> jnp.ndarray:
+    """Candidate input tensor ``[B, F, P, …]``; branch 0 = repeat-last."""
+    last_bits = jnp.asarray(last_bits)
+    if sampler is None:
+        sampler = repeat_last_sampler
+    return sampler(key, last_bits, num_branches, num_frames)
+
+
+def match_branch(
+    branch_bits: np.ndarray, confirmed_bits: np.ndarray
+) -> Tuple[int, int]:
+    """Longest-prefix match: which branch predicted the confirmed inputs?
+
+    ``branch_bits[B, F, P, …]`` vs ``confirmed_bits[K, P, …]`` (K ≤ F
+    confirmed frames). Returns ``(branch, depth)``: the branch agreeing with
+    the most leading confirmed frames, and how many frames agree. A full
+    match (``depth == K``) means the session can reuse that branch's states
+    outright; a partial match still skips ``depth`` frames of resimulation.
+    Ties break toward branch 0 (the repeat-last baseline).
+    """
+    bb = np.asarray(branch_bits)
+    cb = np.asarray(confirmed_bits)
+    k = cb.shape[0]
+    if k == 0:
+        return 0, 0
+    eq = bb[:, :k].reshape(bb.shape[0], k, -1) == cb.reshape(1, k, -1)
+    frame_ok = eq.all(axis=2)  # [B, K]
+    # Depth of agreement = leading run of True per branch.
+    depth = np.where(
+        frame_ok.all(axis=1), k, frame_ok.argmin(axis=1)
+    )
+    best = int(depth.argmax())  # argmax ties break low → branch 0
+    return best, int(depth[best])
+
+
+@dataclasses.dataclass
+class SpecResult:
+    """One speculative rollout: B branches × F frames from one start state.
+
+    ``rings``/``states`` have a leading branch axis on every leaf;
+    ``checksums[B, F]`` is the per-branch stream of saved-frame checksums;
+    ``branch_bits`` is the input tensor that produced it (kept for
+    :func:`match_branch`); ``start_frame`` labels the first saved frame.
+    """
+
+    rings: SnapshotRing
+    states: WorldState
+    checksums: jnp.ndarray
+    branch_bits: Any
+    start_frame: int
+    num_frames: int
+
+
+class SpeculativeExecutor:
+    """Jit-compiled B-branch × F-frame rollout bound to one schedule + shapes.
+
+    With a mesh, the branch axis is laid out over the mesh's ``branch`` axis
+    (data-parallel: zero cross-device traffic during the rollout; XLA inserts
+    one gather at :meth:`commit`). Without a mesh everything runs on the
+    default device.
+    """
+
+    def __init__(
+        self,
+        schedule: Schedule,
+        num_branches: int,
+        max_frames: int,
+        mesh=None,
+        branch_axis: str = "branch",
+    ):
+        self.schedule = schedule
+        self.num_branches = int(num_branches)
+        self.max_frames = int(max_frames)
+        self.mesh = mesh
+        self.branch_axis = branch_axis
+
+        run = functools.partial(self._run_impl, schedule, self.max_frames)
+        commit = self._commit_impl
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            spec_b = NamedSharding(mesh, P(branch_axis))
+            rep = NamedSharding(mesh, P())
+            # state, frame, bits, status replicated in; branch-stacked out.
+            self._run = jax.jit(
+                run,
+                in_shardings=(rep, rep, spec_b, rep),
+                out_shardings=(spec_b, spec_b, spec_b),
+            )
+            self._commit = jax.jit(commit, out_shardings=rep)
+        else:
+            self._run = jax.jit(run)
+            self._commit = jax.jit(commit)
+
+    @staticmethod
+    def _run_impl(schedule, max_frames, state, start_frame, branch_bits, status):
+        """All-branch rollout. Each branch: fresh ring of depth
+        ``max_frames``, then (save, advance) × F — identical semantics to F
+        serial SaveGameState/AdvanceFrame request pairs per branch."""
+        depth = max_frames
+
+        def fresh_ring(st: WorldState) -> SnapshotRing:
+            stacked = jax.tree_util.tree_map(
+                lambda x: jnp.broadcast_to(x[None], (depth,) + x.shape), st
+            )
+            return SnapshotRing(
+                states=stacked,
+                frames=jnp.full((depth,), -1, dtype=jnp.int32),
+                checksums=jnp.zeros((depth,), dtype=jnp.uint32),
+            )
+
+        mask = jnp.ones((max_frames,), dtype=jnp.bool_)
+
+        def one_branch(bits):
+            ring = fresh_ring(state)
+            return rollout_burst(
+                schedule, ring, state, start_frame, bits, status, mask, mask
+            )
+
+        return jax.vmap(one_branch)(branch_bits)
+
+    @staticmethod
+    def _commit_impl(tree, branch):
+        return jax.tree_util.tree_map(
+            lambda x: jax.lax.dynamic_index_in_dim(x, branch, 0, keepdims=False),
+            tree,
+        )
+
+    # ------------------------------------------------------------------
+
+    def run(
+        self,
+        state: WorldState,
+        start_frame: int,
+        branch_bits,
+        status=None,
+    ) -> SpecResult:
+        """Roll all branches forward from ``state`` at ``start_frame``.
+
+        ``branch_bits[B, F, P, …]`` (see :func:`enumerate_branches`);
+        ``status[F, P]`` defaults to all-PREDICTED (speculative frames are by
+        definition unconfirmed).
+        """
+        branch_bits = jnp.asarray(branch_bits)
+        b, f = branch_bits.shape[0], branch_bits.shape[1]
+        if b != self.num_branches or f != self.max_frames:
+            raise ValueError(
+                f"branch_bits [{b}, {f}, …] != configured "
+                f"[{self.num_branches}, {self.max_frames}, …]"
+            )
+        num_players = branch_bits.shape[2]
+        if status is None:
+            status = jnp.full((f, num_players), PREDICTED, dtype=jnp.int32)
+        rings, states, checksums = self._run(
+            state, jnp.asarray(start_frame, jnp.int32), branch_bits,
+            jnp.asarray(status, jnp.int32),
+        )
+        return SpecResult(
+            rings=rings,
+            states=states,
+            checksums=checksums,
+            branch_bits=branch_bits,
+            start_frame=int(start_frame),
+            num_frames=f,
+        )
+
+    def commit(self, result: SpecResult, branch: int):
+        """Gather branch ``branch``'s (ring, state) — the confirmed-branch
+        select + scatter-back (survey §2.3). One collective gather when the
+        branch axis is sharded."""
+        branch = jnp.asarray(branch, jnp.int32)
+        ring = self._commit(result.rings, branch)
+        state = self._commit(result.states, branch)
+        return ring, state
+
+
+def merge_rings(main: SnapshotRing, spec: SnapshotRing) -> SnapshotRing:
+    """Overlay the saved slots of ``spec`` (a committed speculative ring)
+    onto the session's persistent ring: slots ``spec`` actually saved
+    (``frames >= 0``) win; untouched slots keep ``main``'s history. Rings
+    must share depth."""
+    if main.depth != spec.depth:
+        raise ValueError(f"ring depth mismatch: {main.depth} != {spec.depth}")
+    take = spec.frames >= 0
+
+    def sel(s, m):
+        mask = take.reshape((-1,) + (1,) * (s.ndim - 1))
+        return jnp.where(mask, s, m)
+
+    return SnapshotRing(
+        states=jax.tree_util.tree_map(sel, spec.states, main.states),
+        frames=jnp.where(take, spec.frames, main.frames),
+        checksums=jnp.where(take, spec.checksums, main.checksums),
+    )

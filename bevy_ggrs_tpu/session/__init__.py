@@ -33,3 +33,6 @@ from bevy_ggrs_tpu.session.common import (
 from bevy_ggrs_tpu.session.requests import AdvanceFrame, LoadGameState, SaveGameState
 from bevy_ggrs_tpu.session.input_queue import InputQueue
 from bevy_ggrs_tpu.session.synctest import SyncTestSession
+from bevy_ggrs_tpu.session.p2p import P2PSession
+from bevy_ggrs_tpu.session.spectator import SpectatorSession
+from bevy_ggrs_tpu.session.builder import PlayerType, SessionBuilder

@@ -1,0 +1,431 @@
+"""P2PSession: GGPO-style rollback netcode session.
+
+From-scratch reimplementation of the ggrs ``P2PSession`` semantics the
+reference consumes (survey §2.2 contract table; usage at
+`/root/reference/src/ggrs_stage.rs:213-257`):
+
+- remote inputs that haven't arrived are *predicted* (repeat last confirmed);
+- ``advance_frame()`` optimistically emits ``[Save(F), Advance(i_F)]``;
+- when a late-arriving confirmed input contradicts a prediction, the next
+  ``advance_frame()`` prepends ``Load(F_bad)`` + corrected
+  ``(Save, Advance)`` pairs replaying ``F_bad .. F_now`` — up to
+  ``max_prediction`` frames of resimulation in one call;
+- running more than ``max_prediction`` frames past the last confirmed input
+  raises :class:`PredictionThreshold` (the caller skips the frame —
+  `ggrs_stage.rs:251-253`);
+- ``frames_ahead() > 0`` tells the driver to pace ×1.1 slower
+  (`ggrs_stage.rs:107-109,227`);
+- sessions start SYNCHRONIZING and only run after the sync handshake
+  (`ggrs_stage.rs:244` gate);
+- per-peer events (synchronized / interrupted / resumed / disconnected) and
+  ``network_stats(handle)`` mirror the observability surface the examples
+  pump (`examples/box_game/box_game_p2p.rs:107-129`).
+
+Spectator fan-out: host-side, every spectator address gets a stream of
+*confirmed* inputs for all players (the feed a
+:class:`~bevy_ggrs_tpu.session.spectator.SpectatorSession` consumes).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bevy_ggrs_tpu.schedule import CONFIRMED, DISCONNECTED, PREDICTED, InputSpec
+from bevy_ggrs_tpu.session import protocol as proto
+from bevy_ggrs_tpu.session.common import (
+    EventKind,
+    InvalidRequest,
+    NetworkStats,
+    NotSynchronized,
+    PredictionThreshold,
+    SessionEvent,
+    SessionState,
+    NULL_FRAME,
+)
+from bevy_ggrs_tpu.session.endpoint import PeerEndpoint, PeerState
+from bevy_ggrs_tpu.session.input_queue import InputQueue
+from bevy_ggrs_tpu.session.requests import AdvanceFrame, LoadGameState, SaveGameState
+
+CHECKSUM_SEND_INTERVAL = 16  # frames between checksum reports to peers
+
+
+class P2PSession:
+    """Use :class:`~bevy_ggrs_tpu.session.builder.SessionBuilder` to
+    construct (``start_p2p_session(socket)``)."""
+
+    def __init__(
+        self,
+        num_players: int,
+        input_spec: InputSpec,
+        socket,
+        local_players: Dict[int, None],
+        remote_players: Dict[int, object],  # handle -> addr
+        spectators: Sequence[object],  # addrs
+        max_prediction: int = 8,
+        input_delay: int = 0,
+        disconnect_timeout: float = 2.0,
+        disconnect_notify_start: float = 0.5,
+        fps: int = 60,
+        seed: int = 0,
+        clock=None,
+    ):
+        self.num_players = int(num_players)
+        self.input_spec = input_spec
+        self.socket = socket
+        self.max_prediction = int(max_prediction)
+        self.input_delay = int(input_delay)
+        self.fps = int(fps)
+        self._clock = clock if clock is not None else _time.monotonic
+
+        zero = input_spec.zeros_np(1)[0]
+        self._zero = zero
+        self._queues = [
+            InputQueue(zero, input_delay if h in local_players else 0)
+            for h in range(num_players)
+        ]
+        self.local_handles = sorted(local_players)
+        self._handle_addr: Dict[int, object] = dict(remote_players)
+        self._disconnected: Dict[int, int] = {}  # handle -> frame of disconnect
+
+        rng = np.random.RandomState(seed)
+        self._endpoints: Dict[object, PeerEndpoint] = {}
+        for addr in set(remote_players.values()) | set(spectators):
+            self._endpoints[addr] = PeerEndpoint(
+                addr,
+                rng,
+                disconnect_timeout=disconnect_timeout,
+                disconnect_notify_start=disconnect_notify_start,
+            )
+        self._spectator_addrs = list(spectators)
+        # Confirmed-input fan-out cursor per spectator address.
+        self._spec_sent: Dict[object, int] = {a: NULL_FRAME for a in spectators}
+
+        self.current_frame = 0
+        self._pending_local: Dict[int, np.ndarray] = {}
+        # Inputs actually used per simulated frame: frame -> (bits[P,…],
+        # status[P]); the record predictions are checked against.
+        self._used: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        self._first_incorrect = NULL_FRAME
+        self._events: List[SessionEvent] = []
+        self._local_checksums: Dict[int, int] = {}
+        self._last_checksum_sent = NULL_FRAME
+        self._desynced_frames: set = set()
+
+    # ------------------------------------------------------------------
+    # Introspection (stage-driver surface, survey §2.2)
+
+    def current_state(self) -> SessionState:
+        """RUNNING once every remote *player* has completed the sync
+        handshake. Spectator endpoints sync opportunistically but never
+        gate the players (a dead spectator must not block the match)."""
+        player_addrs = set(self._handle_addr.values())
+        for addr in player_addrs:
+            if self._endpoints[addr].state == PeerState.SYNCHRONIZING:
+                return SessionState.SYNCHRONIZING
+        return SessionState.RUNNING
+
+    def local_player_handles(self) -> List[int]:
+        return list(self.local_handles)
+
+    def remote_player_handles(self) -> List[int]:
+        return sorted(self._handle_addr)
+
+    def confirmed_frame(self) -> int:
+        """Highest frame for which every connected player's input is
+        confirmed (local inputs confirm at add time, after input delay)."""
+        frames = [
+            q.last_confirmed_frame
+            for h, q in enumerate(self._queues)
+            if h not in self._disconnected
+        ]
+        return min(frames) if frames else NULL_FRAME
+
+    def frames_ahead(self) -> int:
+        """How many frames we should yield to let slower peers catch up
+        (>0 ⇒ the driver runs ×1.1 slower, `ggrs_stage.rs:107-109,227`).
+        GGPO time sync: half the gap between our frame advantage over the
+        peer and the peer's self-reported advantage."""
+        worst = 0
+        for ep in self._endpoints.values():
+            if ep.state != PeerState.RUNNING or ep.remote_frame == NULL_FRAME:
+                continue
+            local_adv = self.current_frame - ep.remote_frame
+            worst = max(worst, (local_adv - ep.remote_advantage) // 2)
+        return worst
+
+    def network_stats(self, handle: int) -> NetworkStats:
+        addr = self._handle_addr.get(handle)
+        if addr is None:
+            raise InvalidRequest(f"handle {handle} is not a remote player")
+        return self._endpoints[addr].stats(self._clock(), self.current_frame)
+
+    def events(self) -> List[SessionEvent]:
+        out, self._events = self._events, []
+        return out
+
+    # ------------------------------------------------------------------
+    # Network pump (`poll_remote_clients`, ggrs_stage.rs:113-119)
+
+    def poll_remote_clients(self, now: Optional[float] = None) -> None:
+        now = self._clock() if now is None else now
+        for addr, data in self.socket.receive_all():
+            ep = self._endpoints.get(addr)
+            if ep is None:
+                continue  # unknown peer: drop (untrusted input)
+            msg = proto.decode(data)
+            if msg is None:
+                continue
+            ep.on_message(msg, now, self._on_remote_inputs)
+
+        self._check_desync()
+        self._maybe_send_checksums(now)
+
+        local_adv = self._local_advantage()
+        for addr, ep in self._endpoints.items():
+            before = ep.state
+            ep.poll(now, self.current_frame, local_adv)
+            if before != PeerState.DISCONNECTED and ep.state == PeerState.DISCONNECTED:
+                self._on_peer_disconnected(addr)
+            ack = self._ack_frame_for(addr)
+            ep.send_pending_inputs(now, self.current_frame, local_adv, ack)
+            self._events.extend(ep.events)
+            ep.events.clear()
+            for data in ep.outbox:
+                self.socket.send_to(data, addr)
+            ep.outbox.clear()
+
+        ahead = self.frames_ahead()
+        if ahead > 0:
+            self._events.append(
+                SessionEvent(EventKind.WAIT_RECOMMENDATION, data={"skip_frames": ahead})
+            )
+
+    def _local_advantage(self) -> int:
+        """Our frame advantage over the slowest running peer (sent in input
+        msgs / quality reports for the peer's own frames_ahead)."""
+        adv = 0
+        for ep in self._endpoints.values():
+            if ep.state == PeerState.RUNNING and ep.remote_frame != NULL_FRAME:
+                adv = max(adv, self.current_frame - ep.remote_frame)
+        return adv
+
+    def _ack_frame_for(self, addr: object) -> int:
+        handles = [h for h, a in self._handle_addr.items() if a == addr]
+        if not handles:
+            return NULL_FRAME
+        return min(self._queues[h].last_confirmed_frame for h in handles)
+
+    def _on_remote_inputs(self, msg: proto.InputMsg) -> None:
+        h = msg.handle
+        if not 0 <= h < self.num_players or h not in self._handle_addr:
+            return
+        queue = self._queues[h]
+        for frame, bits in proto.unpack_input_span(
+            msg, np.dtype(self._zero.dtype), self._zero.shape
+        ):
+            if frame != queue.last_confirmed_frame + 1:
+                if frame <= queue.last_confirmed_frame:
+                    continue  # redundant resend
+                break  # gap (loss beyond span) — wait for next resend
+            queue.add_input(frame, bits)
+            self._note_confirmed(h, frame, queue.confirmed(frame))
+
+    def _note_confirmed(self, handle: int, frame: int, bits: np.ndarray) -> None:
+        """A confirmed input arrived; if we already simulated ``frame`` with
+        a different prediction, schedule a rollback to it."""
+        used = self._used.get(frame)
+        if used is None:
+            return
+        used_bits, used_status = used
+        if used_status[handle] == PREDICTED and not np.array_equal(
+            used_bits[handle], bits
+        ):
+            if self._first_incorrect == NULL_FRAME or frame < self._first_incorrect:
+                self._first_incorrect = frame
+
+    def _on_peer_disconnected(self, addr: object) -> None:
+        """All handles at ``addr`` become disconnected: their inputs freeze
+        at repeat-last (== our prediction, so no rollback is needed) with
+        DISCONNECTED status from here on."""
+        for h, a in self._handle_addr.items():
+            if a == addr and h not in self._disconnected:
+                self._disconnected[h] = self.current_frame
+
+    def disconnect_player(self, handle: int) -> None:
+        """Voluntarily drop a remote player (ggrs ``disconnect_player``)."""
+        addr = self._handle_addr.get(handle)
+        if addr is None:
+            raise InvalidRequest(f"handle {handle} is not remote")
+        ep = self._endpoints[addr]
+        if ep.state != PeerState.DISCONNECTED:
+            ep.state = PeerState.DISCONNECTED
+            self._events.append(SessionEvent(EventKind.DISCONNECTED, addr=addr))
+        self._on_peer_disconnected(addr)
+
+    # ------------------------------------------------------------------
+    # Checksums / desync detection
+
+    def report_checksum(self, frame: int, checksum: int) -> None:
+        """Driver reports each saved frame's checksum (the
+        ``GameStateCell::save`` analog). Resimulated frames overwrite —
+        only *confirmed* frames are comparable across peers."""
+        self._local_checksums[frame] = int(checksum)
+        horizon = self.confirmed_frame() - 4 * CHECKSUM_SEND_INTERVAL
+        for f in [f for f in self._local_checksums if f < horizon]:
+            del self._local_checksums[f]
+
+    def _settled(self, frame: int) -> bool:
+        """A frame's local checksum is final iff every input ≤ it is
+        confirmed AND no pending rollback reaches it (a mispredicted frame's
+        checksum is stale until the next ``advance_frame`` resimulates and
+        re-reports it)."""
+        if frame > self.confirmed_frame():
+            return False
+        return self._first_incorrect == NULL_FRAME or frame < self._first_incorrect
+
+    def _maybe_send_checksums(self, now: float) -> None:
+        target = (
+            self.confirmed_frame() // CHECKSUM_SEND_INTERVAL
+        ) * CHECKSUM_SEND_INTERVAL
+        if target <= self._last_checksum_sent or target < 0:
+            return
+        if not self._settled(target):
+            return  # retry next poll, after the rollback corrects it
+        cs = self._local_checksums.get(target)
+        if cs is None:
+            return
+        for ep in self._endpoints.values():
+            if ep.state == PeerState.RUNNING:
+                ep.send_checksum(target, cs, now)
+        self._last_checksum_sent = target
+
+    def _check_desync(self) -> None:
+        for ep in self._endpoints.values():
+            for frame in sorted(ep.remote_checksums):
+                if not self._settled(frame):
+                    continue  # keep until our own checksum is final
+                remote = ep.remote_checksums[frame]
+                local = self._local_checksums.get(frame)
+                if (
+                    local is not None
+                    and local != remote
+                    and frame not in self._desynced_frames
+                ):
+                    self._desynced_frames.add(frame)
+                    self._events.append(
+                        SessionEvent(
+                            EventKind.DESYNC_DETECTED,
+                            addr=ep.addr,
+                            data={"frame": frame, "local": local, "remote": remote},
+                        )
+                    )
+                del ep.remote_checksums[frame]
+
+    # ------------------------------------------------------------------
+    # Input + advance (the protocol heart)
+
+    def add_local_input(self, handle: int, bits) -> None:
+        """Feed this frame's input for a local player (`ggrs_stage.rs:246`).
+        Must be called for every local handle before ``advance_frame``."""
+        if handle not in self.local_handles:
+            raise InvalidRequest(f"handle {handle} is not local")
+        if self.current_state() != SessionState.RUNNING:
+            raise NotSynchronized("session is still synchronizing")
+        self._pending_local[handle] = np.asarray(
+            bits, dtype=self._zero.dtype
+        ).reshape(self._zero.shape)
+
+    def advance_frame(self) -> List[object]:
+        if self.current_state() != SessionState.RUNNING:
+            raise NotSynchronized("session is still synchronizing")
+        missing = [h for h in self.local_handles if h not in self._pending_local]
+        if missing:
+            raise InvalidRequest(f"missing local input for handles {missing}")
+
+        # Back-pressure (`GGRSError::PredictionThreshold`): refuse to run
+        # more than max_prediction frames past the last confirmed input.
+        confirmed = self.confirmed_frame()
+        if self.current_frame - confirmed > self.max_prediction:
+            raise PredictionThreshold(
+                f"frame {self.current_frame} is more than {self.max_prediction} "
+                f"frames past last confirmed {confirmed}"
+            )
+
+        # Commit local inputs (after input delay) and stage them for send.
+        frame = self.current_frame
+        spectators = set(self._spectator_addrs)
+        for h in self.local_handles:
+            target = self._queues[h].add_local_input(frame, self._pending_local[h])
+            for addr, ep in self._endpoints.items():
+                if addr in spectators:
+                    continue  # spectators get the confirmed fan-out instead
+                for f in range(
+                    max(0, target - (self._queues[h].delay or 0)), target + 1
+                ):
+                    got = self._queues[h].confirmed(f)
+                    if got is not None:
+                        ep.queue_input(h, f, got)
+        self._pending_local.clear()
+
+        requests: List[object] = []
+
+        # Rollback: a confirmed input contradicted a prediction.
+        if self._first_incorrect != NULL_FRAME:
+            rollback_to = self._first_incorrect
+            requests.append(LoadGameState(rollback_to))
+            for f in range(rollback_to, frame):
+                requests.append(SaveGameState(f))
+                requests.append(self._advance_request(f))
+            self._first_incorrect = NULL_FRAME
+
+        # The new frame.
+        requests.append(SaveGameState(frame))
+        requests.append(self._advance_request(frame))
+        self.current_frame = frame + 1
+
+        self._fanout_spectators()
+        self._gc()
+        return requests
+
+    def _advance_request(self, frame: int) -> AdvanceFrame:
+        bits = np.empty((self.num_players,) + self._zero.shape, self._zero.dtype)
+        status = np.empty((self.num_players,), np.int32)
+        for h, q in enumerate(self._queues):
+            b, is_confirmed = q.input(frame)
+            bits[h] = b
+            if h in self._disconnected and frame >= self._disconnected[h]:
+                status[h] = DISCONNECTED
+            else:
+                status[h] = CONFIRMED if is_confirmed else PREDICTED
+        self._used[frame] = (bits.copy(), status.copy())
+        return AdvanceFrame(bits=bits, status=status)
+
+    def _fanout_spectators(self) -> None:
+        """Queue newly-confirmed inputs of ALL players to every spectator."""
+        if not self._spectator_addrs:
+            return
+        confirmed = self.confirmed_frame()
+        for addr in self._spectator_addrs:
+            ep = self._endpoints[addr]
+            start = self._spec_sent[addr] + 1
+            for f in range(start, confirmed + 1):
+                for h, q in enumerate(self._queues):
+                    got = q.confirmed(f)
+                    if got is None and h in self._disconnected:
+                        got, _ = q.input(f)
+                    if got is not None:
+                        ep.queue_input(h, f, got)
+            self._spec_sent[addr] = max(self._spec_sent[addr], confirmed)
+
+    def _gc(self) -> None:
+        """Drop history that can no longer participate in a rollback."""
+        horizon = min(
+            self.confirmed_frame(), self.current_frame - self.max_prediction - 1
+        )
+        for q in self._queues:
+            q.discard_before(horizon)
+        for f in [f for f in self._used if f < horizon]:
+            del self._used[f]

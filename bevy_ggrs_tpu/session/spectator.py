@@ -1,0 +1,159 @@
+"""SpectatorSession: receive confirmed inputs from a host; never roll back.
+
+The reference's spectator flavor (`/root/reference/src/ggrs_stage.rs:195-211`)
+advances only on confirmed host data — its request lists contain ONLY
+``AdvanceFrame`` (no saves, no loads), and when the host's inputs haven't
+arrived it waits (`ggrs_stage.rs:205-207` logs "waiting for host").
+
+Catch-up: when more than ``catchup_threshold`` confirmed frames are buffered,
+``advance_frame()`` emits up to ``max_frames_behind`` advances in one call so
+a lagging spectator converges on the live session instead of falling ever
+further behind.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import List, Optional
+
+import numpy as np
+
+from bevy_ggrs_tpu.schedule import CONFIRMED, InputSpec
+from bevy_ggrs_tpu.session import protocol as proto
+from bevy_ggrs_tpu.session.common import (
+    EventKind,
+    NetworkStats,
+    NotSynchronized,
+    PredictionThreshold,
+    SessionEvent,
+    SessionState,
+    NULL_FRAME,
+)
+from bevy_ggrs_tpu.session.endpoint import PeerEndpoint, PeerState
+from bevy_ggrs_tpu.session.input_queue import InputQueue
+from bevy_ggrs_tpu.session.requests import AdvanceFrame
+
+
+class SpectatorSession:
+    def __init__(
+        self,
+        num_players: int,
+        input_spec: InputSpec,
+        socket,
+        host_addr,
+        catchup_threshold: int = 8,
+        max_frames_behind: int = 4,
+        seed: int = 0,
+        clock=None,
+    ):
+        self.num_players = int(num_players)
+        self.input_spec = input_spec
+        self.socket = socket
+        self.host_addr = host_addr
+        self.catchup_threshold = int(catchup_threshold)
+        self.max_frames_behind = int(max_frames_behind)
+        self._clock = clock if clock is not None else _time.monotonic
+
+        self._zero = input_spec.zeros_np(1)[0]
+        self._queues = [InputQueue(self._zero, 0) for _ in range(num_players)]
+        rng = np.random.RandomState(seed)
+        self._endpoint = PeerEndpoint(host_addr, rng)
+        self.current_frame = 0
+        self._events: List[SessionEvent] = []
+
+    # ------------------------------------------------------------------
+
+    def current_state(self) -> SessionState:
+        if self._endpoint.state == PeerState.SYNCHRONIZING:
+            return SessionState.SYNCHRONIZING
+        return SessionState.RUNNING
+
+    def local_player_handles(self) -> List[int]:
+        return []  # spectators never contribute input
+
+    def frames_behind_host(self) -> int:
+        host_frame = self._endpoint.remote_frame
+        return max(0, host_frame - self.current_frame) if host_frame != NULL_FRAME else 0
+
+    def network_stats(self) -> NetworkStats:
+        return self._endpoint.stats(self._clock(), self.current_frame)
+
+    def events(self) -> List[SessionEvent]:
+        out, self._events = self._events, []
+        return out
+
+    # ------------------------------------------------------------------
+
+    def poll_remote_clients(self, now: Optional[float] = None) -> None:
+        now = self._clock() if now is None else now
+        got_inputs = False
+        for addr, data in self.socket.receive_all():
+            if addr != self.host_addr:
+                continue
+            msg = proto.decode(data)
+            if msg is None:
+                continue
+            if isinstance(msg, proto.InputMsg):
+                got_inputs = True
+            self._endpoint.on_message(msg, now, self._on_inputs)
+        if got_inputs:
+            # Ack per handle so the host trims its pending span — without
+            # this the host's redundant resend grows O(frames) forever.
+            for h, q in enumerate(self._queues):
+                if q.last_confirmed_frame >= 0:
+                    self._endpoint.send_input_ack(h, q.last_confirmed_frame, now)
+        self._endpoint.poll(now, self.current_frame, 0)
+        self._events.extend(self._endpoint.events)
+        self._endpoint.events.clear()
+        for data in self._endpoint.outbox:
+            self.socket.send_to(data, self.host_addr)
+        self._endpoint.outbox.clear()
+
+    def _on_inputs(self, msg: proto.InputMsg) -> None:
+        h = msg.handle
+        if not 0 <= h < self.num_players:
+            return
+        queue = self._queues[h]
+        for frame, bits in proto.unpack_input_span(
+            msg, np.dtype(self._zero.dtype), self._zero.shape
+        ):
+            if frame <= queue.last_confirmed_frame:
+                continue
+            if frame != queue.last_confirmed_frame + 1:
+                break  # gap: wait for the redundant resend
+            queue.add_input(frame, bits)
+
+    # ------------------------------------------------------------------
+
+    def _confirmed_frame(self) -> int:
+        return min(q.last_confirmed_frame for q in self._queues)
+
+    def advance_frame(self) -> List[AdvanceFrame]:
+        """Only ``AdvanceFrame`` requests, only on confirmed data.
+
+        Raises :class:`PredictionThreshold` when the host's inputs for the
+        next frame haven't arrived (the reference logs "Waiting for input
+        from host" and skips, `ggrs_stage.rs:205-207`).
+        """
+        if self.current_state() != SessionState.RUNNING:
+            raise NotSynchronized("spectator has not synchronized with host")
+        confirmed = self._confirmed_frame()
+        if confirmed < self.current_frame:
+            raise PredictionThreshold(
+                f"waiting for host input for frame {self.current_frame}"
+            )
+        behind = confirmed - self.current_frame + 1
+        n = 1
+        if behind > self.catchup_threshold:
+            n = min(behind, self.max_frames_behind)
+        requests = []
+        for _ in range(n):
+            frame = self.current_frame
+            bits = np.stack([q.input(frame)[0] for q in self._queues])
+            status = np.full((self.num_players,), CONFIRMED, dtype=np.int32)
+            requests.append(AdvanceFrame(bits=bits, status=status))
+            self.current_frame = frame + 1
+        horizon = self.current_frame - 2
+        for q in self._queues:
+            q.discard_before(horizon)
+        return requests

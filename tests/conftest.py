@@ -1,12 +1,22 @@
 """Test config: run everything on a virtual 8-device CPU mesh.
 
-Must set the env vars before jax is imported anywhere — conftest is imported
-first by pytest, so this is the single authoritative place.
+This image's sitecustomize pre-imports jax and force-selects the remote-TPU
+platform via ``jax.config.update("jax_platforms", ...)`` — which overrides
+the ``JAX_PLATFORMS`` env var. So the env var alone is not enough: we must
+(a) inject the virtual-device XLA flag before any backend initializes, and
+(b) re-update the config back to cpu. Tests then never touch the TPU tunnel
+and get a deterministic 8-device mesh for sharding coverage.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
