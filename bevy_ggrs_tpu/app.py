@@ -1,0 +1,332 @@
+"""App layer: the GGRSPlugin builder + fixed-timestep stage driver.
+
+TPU-native analog of the reference's L4/L2 surface
+(`/root/reference/src/lib.rs:78-170`, `src/ggrs_stage.rs:102-161`):
+
+- :class:`GGRSPlugin` — fluent builder collecting update frequency, input
+  system, rollback type registrations, and the rollback schedule; ``build()``
+  wires a :class:`GGRSStage` into a :class:`RollbackApp`
+  (`lib.rs:100-169` surface parity, including the "no input system" panic
+  at `lib.rs:157-159`).
+- :class:`RollbackApp` — minimal headless app shell: holds the session
+  resource + :class:`SessionType` switch (`lib.rs:25-36`), the stage, and
+  user "render frame" systems that run outside the rollback domain (the
+  role of the reference's non-rollback schedule stages).
+- :class:`GGRSStage` — the per-render-frame driver (`Stage::run`,
+  `ggrs_stage.rs:102-138`): wall-clock accumulation into fixed sim steps,
+  ×1.1 frame-period stretch while ahead of peers (`:105-111`), session
+  polling every render frame (`:113-119`), per-step dispatch on the session
+  flavor (`:129-135`), and full state reset when the session resource is
+  removed (`:134,155-161`).
+"""
+
+from __future__ import annotations
+
+import enum
+import time as _time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from bevy_ggrs_tpu.runner import RollbackRunner
+from bevy_ggrs_tpu.schedule import InputSpec, Schedule
+from bevy_ggrs_tpu.session.common import (
+    NotSynchronized,
+    PredictionThreshold,
+    SessionState,
+)
+from bevy_ggrs_tpu.session.p2p import P2PSession
+from bevy_ggrs_tpu.session.spectator import SpectatorSession
+from bevy_ggrs_tpu.session.synctest import SyncTestSession
+from bevy_ggrs_tpu.state import HostWorld, TypeRegistry, WorldState
+
+DEFAULT_FPS = 60  # `lib.rs:22`
+
+
+class SessionType(enum.Enum):
+    """`SessionType::{SyncTestSession, P2PSession, SpectatorSession}`
+    resource switch (`src/lib.rs:25-36`); defaults to SyncTest there."""
+
+    SYNC_TEST = "sync_test"
+    P2P = "p2p"
+    SPECTATOR = "spectator"
+
+
+class RollbackIdProvider:
+    """Monotonic rollback-id allocator (`src/lib.rs:59-75`)."""
+
+    def __init__(self) -> None:
+        self._next = 0
+
+    def next_id(self) -> int:
+        if self._next >= 2**32 - 1:
+            raise OverflowError("RollbackIdProvider: no more unique ids")
+        out = self._next
+        self._next += 1
+        return out
+
+
+# An input system reads the local player's controls for this sim step:
+# (handle, app) -> bits. The reference boxes a Bevy system with the same
+# role (`lib.rs:111-117`, example at `box_game.rs:61-78`).
+InputSystem = Callable[[int, "RollbackApp"], np.ndarray]
+# A render system runs once per render frame, outside the rollback domain.
+RenderSystem = Callable[["RollbackApp"], None]
+
+
+class RollbackApp:
+    """Headless app shell: session + stage + non-rollback systems."""
+
+    def __init__(self) -> None:
+        self.stage: Optional[GGRSStage] = None
+        self.session = None
+        self.session_type: Optional[SessionType] = None
+        self.rollback_id_provider = RollbackIdProvider()
+        self._render_systems: List[RenderSystem] = []
+        self.events: List[object] = []  # drained session events, app-visible
+
+    # -- resources ------------------------------------------------------
+
+    def insert_session(self, session, session_type: SessionType) -> "RollbackApp":
+        self.session = session
+        self.session_type = session_type
+        return self
+
+    def remove_session(self) -> "RollbackApp":
+        self.session = None
+        self.session_type = None
+        return self
+
+    def add_render_system(self, system: RenderSystem) -> "RollbackApp":
+        self._render_systems.append(system)
+        return self
+
+    # -- introspection --------------------------------------------------
+
+    def world(self):
+        """Host view of the current rollback world (device→host sync)."""
+        return self.stage.runner.world()
+
+    @property
+    def frame(self) -> int:
+        return self.stage.runner.frame
+
+    # -- main loop ------------------------------------------------------
+
+    def update(self, now: Optional[float] = None) -> int:
+        """One render frame (`Stage::run`): returns sim steps executed."""
+        steps = self.stage.run(self, now)
+        for system in self._render_systems:
+            system(self)
+        return steps
+
+    def run_for(self, render_frames: int, dt: Optional[float] = None) -> None:
+        """Drive ``render_frames`` frames. With ``dt`` given, time is
+        virtual (deterministic tests/examples); else wall clock."""
+        if dt is None:
+            for _ in range(render_frames):
+                self.update()
+        else:
+            now = self.stage.last_time if self.stage.last_time is not None else 0.0
+            for _ in range(render_frames):
+                now += dt
+                self.update(now)
+
+
+class GGRSStage:
+    """Fixed-timestep driver executing the session request protocol on the
+    device-resident runner."""
+
+    def __init__(
+        self,
+        schedule: Schedule,
+        input_system: InputSystem,
+        initial_state: WorldState,
+        num_players: int,
+        input_spec: InputSpec,
+        max_prediction: int,
+        update_frequency: int = DEFAULT_FPS,
+        clock=None,
+    ):
+        self.input_system = input_system
+        self.update_frequency = int(update_frequency)
+        self.runner = RollbackRunner(
+            schedule,
+            initial_state,
+            max_prediction=max_prediction,
+            num_players=num_players,
+            input_spec=input_spec,
+        )
+        self._clock = clock if clock is not None else _time.monotonic
+        # Compile the rollout executable now, before any session handshake:
+        # a first-frame compile stall on a slow host can blow through the
+        # peer disconnect timeout.
+        self.runner.warmup()
+        self.accumulator = 0.0
+        self.last_time: Optional[float] = None
+        self.run_slow = False
+        # Observability counters (survey §5 "add: per-phase timing" seed).
+        self.steps_total = 0
+        self.frames_skipped = 0
+
+    def reset(self) -> None:
+        """Driver state clear when the session resource disappears
+        (`ggrs_stage.rs:155-161`)."""
+        self.accumulator = 0.0
+        self.last_time = None
+        self.run_slow = False
+
+    # ------------------------------------------------------------------
+
+    def run(self, app: RollbackApp, now: Optional[float] = None) -> int:
+        now = self._clock() if now is None else now
+        if app.session is None:
+            self.reset()
+            return 0
+        if self.last_time is None:
+            self.last_time = now
+        delta = max(0.0, now - self.last_time)
+        self.last_time = now
+
+        fps_delta = 1.0 / self.update_frequency
+        if self.run_slow:
+            fps_delta *= 1.1  # catch-up stretch (`ggrs_stage.rs:107-109`)
+
+        # Pump the network every render frame, unconditionally
+        # (`ggrs_stage.rs:113-119`).
+        if app.session_type in (SessionType.P2P, SessionType.SPECTATOR):
+            app.session.poll_remote_clients(now)
+            app.events.extend(app.session.events())
+
+        self.accumulator += delta
+        steps = 0
+        while self.accumulator >= fps_delta:
+            self.accumulator -= fps_delta
+            if app.session_type == SessionType.SYNC_TEST:
+                self._step_synctest(app)
+            elif app.session_type == SessionType.P2P:
+                self._step_p2p(app)
+            elif app.session_type == SessionType.SPECTATOR:
+                self._step_spectator(app)
+            steps += 1
+        self.steps_total += steps
+        return steps
+
+    # -- per-flavor steps (`run_synctest`/`run_p2p`/`run_spectator`) ----
+
+    def _step_synctest(self, app: RollbackApp) -> None:
+        session: SyncTestSession = app.session
+        for handle in session.local_player_handles():
+            session.add_local_input(handle, self.input_system(handle, app))
+        self.runner.handle_requests(session.advance_frame(), session)
+
+    def _step_p2p(self, app: RollbackApp) -> None:
+        session: P2PSession = app.session
+        if session.current_state() != SessionState.RUNNING:
+            return
+        self.run_slow = session.frames_ahead() > 0
+        for handle in session.local_player_handles():
+            session.add_local_input(handle, self.input_system(handle, app))
+        try:
+            requests = session.advance_frame()
+        except PredictionThreshold:
+            self.frames_skipped += 1  # `ggrs_stage.rs:251-253`: skip + log
+            return
+        self.runner.handle_requests(requests, session)
+
+    def _step_spectator(self, app: RollbackApp) -> None:
+        session: SpectatorSession = app.session
+        if session.current_state() != SessionState.RUNNING:
+            return
+        try:
+            requests = session.advance_frame()
+        except (PredictionThreshold, NotSynchronized):
+            self.frames_skipped += 1  # waiting for host (`:205-207`)
+            return
+        self.runner.handle_requests(requests, session)
+
+
+class GGRSPlugin:
+    """Fluent builder (`GGRSPlugin`, `src/lib.rs:78-170`)."""
+
+    def __init__(self, input_spec: InputSpec = InputSpec()):
+        self.input_spec = input_spec
+        self.update_frequency = DEFAULT_FPS
+        self.registry = TypeRegistry()
+        self.schedule = Schedule()
+        self.input_system: Optional[InputSystem] = None
+        self.capacity = 64
+        self.max_prediction = 8
+        self.num_players = 2
+        self._setup: Optional[Callable[[HostWorld, RollbackApp], None]] = None
+        self.clock = None
+
+    def with_update_frequency(self, fps: int) -> "GGRSPlugin":
+        self.update_frequency = int(fps)
+        return self
+
+    def with_input_system(self, system: InputSystem) -> "GGRSPlugin":
+        self.input_system = system
+        return self
+
+    def register_rollback_component(
+        self, name: str, shape=(), dtype=None, default=0
+    ) -> "GGRSPlugin":
+        import jax.numpy as jnp
+
+        self.registry.register_component(
+            name, shape, jnp.float32 if dtype is None else dtype, default
+        )
+        return self
+
+    def register_rollback_resource(self, name: str, initial) -> "GGRSPlugin":
+        self.registry.register_resource(name, initial)
+        return self
+
+    def with_rollback_schedule(self, schedule: Schedule) -> "GGRSPlugin":
+        self.schedule = schedule
+        return self
+
+    def with_world_capacity(self, capacity: int) -> "GGRSPlugin":
+        self.capacity = int(capacity)
+        return self
+
+    def with_num_players(self, n: int) -> "GGRSPlugin":
+        self.num_players = int(n)
+        return self
+
+    def with_max_prediction_window(self, frames: int) -> "GGRSPlugin":
+        self.max_prediction = int(frames)
+        return self
+
+    def with_setup_system(
+        self, setup: Callable[[HostWorld, RollbackApp], None]
+    ) -> "GGRSPlugin":
+        """The scene-spawn hook (`setup_system`, `box_game.rs:80-140`):
+        receives the staging world + app (for ``rollback_id_provider``)."""
+        self._setup = setup
+        return self
+
+    def with_clock(self, clock) -> "GGRSPlugin":
+        self.clock = clock
+        return self
+
+    def build(self, app: Optional[RollbackApp] = None) -> RollbackApp:
+        if self.input_system is None:
+            # Parity with the reference's explicit panic (`lib.rs:157-159`).
+            raise ValueError("GGRSPlugin: no input system was given")
+        app = app if app is not None else RollbackApp()
+        host = HostWorld(self.registry, self.capacity)
+        if self._setup is not None:
+            self._setup(host, app)
+        app.stage = GGRSStage(
+            schedule=self.schedule,
+            input_system=self.input_system,
+            initial_state=host.commit(),
+            num_players=self.num_players,
+            input_spec=self.input_spec,
+            max_prediction=self.max_prediction,
+            update_frequency=self.update_frequency,
+            clock=self.clock,
+        )
+        return app

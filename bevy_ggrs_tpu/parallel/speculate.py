@@ -174,10 +174,10 @@ class SpeculativeExecutor:
         run = functools.partial(self._run_impl, schedule, self.max_frames)
         commit = self._commit_impl
         if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            from bevy_ggrs_tpu.parallel.sharding import branch_pspec, replicated
 
-            spec_b = NamedSharding(mesh, P(branch_axis))
-            rep = NamedSharding(mesh, P())
+            spec_b = branch_pspec(mesh, branch_axis)
+            rep = replicated(mesh)
             # state, frame, bits, status replicated in; branch-stacked out.
             self._run = jax.jit(
                 run,

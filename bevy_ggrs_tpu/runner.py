@@ -160,6 +160,19 @@ class RollbackRunner:
 
     # ------------------------------------------------------------------
 
+    def warmup(self) -> None:
+        """Compile the fused rollout executable before the session goes
+        live. One call covers every burst shape (bursts are padded to a
+        fixed depth), so real-time frames never hit a compile stall — on a
+        slow host a first-frame compile can exceed the peer disconnect
+        timeout."""
+        zero = self.input_spec.zeros_np(self.num_players)
+        bits = np.zeros((0,) + zero.shape, zero.dtype)
+        status = np.zeros((0, self.num_players), np.int32)
+        # n_frames=0: every step masked invalid — compiles without touching
+        # the live ring/state (results discarded).
+        self.executor.run(self.ring, self.state, 0, bits, status, n_frames=0)
+
     def world(self):
         """Host copy of the current world (the confirmed-state scatter-back
         boundary — the only place non-rollback code should read from)."""

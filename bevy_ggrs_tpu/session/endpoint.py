@@ -34,6 +34,9 @@ KEEP_ALIVE_INTERVAL = 0.2
 CHECKSUM_REPORT_INTERVAL_FRAMES = 16
 DEFAULT_DISCONNECT_TIMEOUT = 2.0
 DEFAULT_DISCONNECT_NOTIFY_START = 0.5
+# Max frames per InputMsg: keeps the wire span well under the uint16 field
+# and one MTU even for late-joining spectators catching up on long history.
+MAX_INPUT_SPAN = 120
 
 
 class PeerState(enum.Enum):
@@ -62,6 +65,10 @@ class PeerEndpoint:
 
         # Outgoing input spans, per local handle: frame -> bits (unacked).
         self._pending_output: Dict[int, Dict[int, np.ndarray]] = {}
+        # Handles we relay on behalf of a disconnected peer: the generic
+        # piggybacked ack in InputMsg covers only the sender's OWN handles,
+        # so relayed handles are trimmed exclusively by explicit InputAcks.
+        self._relay_handles: set = set()
 
         self._last_recv = 0.0
         self._last_send = -1e9
@@ -113,6 +120,7 @@ class PeerEndpoint:
         idle = now - self._last_recv
         if idle > self.disconnect_timeout:
             self.state = PeerState.DISCONNECTED
+            self._pending_output.clear()  # nothing will ever ack these
             self._emit(EventKind.DISCONNECTED)
             return
         if idle > self.disconnect_notify_start and not self._interrupted:
@@ -169,7 +177,8 @@ class PeerEndpoint:
             self.remote_frame = max(self.remote_frame, msg.sender_frame)
             self.remote_advantage = msg.advantage
             for h in list(self._pending_output):
-                self._ack(h, msg.ack_frame)
+                if h not in self._relay_handles:
+                    self._ack(h, msg.ack_frame)
             on_inputs(msg)
         elif isinstance(msg, proto.InputAck):
             self._ack(msg.handle, msg.ack_frame)
@@ -196,8 +205,12 @@ class PeerEndpoint:
 
     # ------------------------------------------------------------------
 
-    def queue_input(self, handle: int, frame: int, bits: np.ndarray) -> None:
+    def queue_input(
+        self, handle: int, frame: int, bits: np.ndarray, relay: bool = False
+    ) -> None:
         self._pending_output.setdefault(handle, {})[frame] = np.asarray(bits)
+        if relay:
+            self._relay_handles.add(handle)
 
     def send_pending_inputs(
         self, now: float, local_frame: int, local_advantage: int, ack_frame: int
@@ -211,20 +224,30 @@ class PeerEndpoint:
             if not pending:
                 continue
             frames = sorted(pending)
-            span = [(f, pending[f]) for f in frames]
-            start, num, payload = proto.pack_input_span(span)
-            self._send(
-                proto.InputMsg(
-                    handle=handle,
-                    start_frame=start,
-                    payload=payload,
-                    num=num,
-                    ack_frame=ack_frame,
-                    sender_frame=local_frame,
-                    advantage=local_advantage,
-                ),
-                now,
-            )
+            for i in range(0, len(frames), MAX_INPUT_SPAN):
+                chunk = frames[i : i + MAX_INPUT_SPAN]
+                span = [(f, pending[f]) for f in chunk]
+                start, num, payload = proto.pack_input_span(span)
+                self._send(
+                    proto.InputMsg(
+                        handle=handle,
+                        start_frame=start,
+                        payload=payload,
+                        num=num,
+                        ack_frame=ack_frame,
+                        sender_frame=local_frame,
+                        advantage=local_advantage,
+                    ),
+                    now,
+                )
+
+    def force_disconnect(self) -> None:
+        """Voluntary disconnect: same state transition + pending clear as
+        the idle-timeout path."""
+        if self.state != PeerState.DISCONNECTED:
+            self.state = PeerState.DISCONNECTED
+            self._pending_output.clear()
+            self._emit(EventKind.DISCONNECTED)
 
     def send_input_ack(self, handle: int, ack_frame: int, now: float) -> None:
         self._send(proto.InputAck(handle, ack_frame), now)

@@ -50,6 +50,9 @@ from bevy_ggrs_tpu.session.input_queue import InputQueue
 from bevy_ggrs_tpu.session.requests import AdvanceFrame, LoadGameState, SaveGameState
 
 CHECKSUM_SEND_INTERVAL = 16  # frames between checksum reports to peers
+# A spectator more than this many confirmed frames behind the fan-out is
+# dropped (bounds host-side history retention; the GGPO policy).
+SPECTATOR_MAX_LAG = 600
 
 
 class P2PSession:
@@ -178,7 +181,13 @@ class P2PSession:
             msg = proto.decode(data)
             if msg is None:
                 continue
-            ep.on_message(msg, now, self._on_remote_inputs)
+            ep.on_message(
+                msg,
+                now,
+                lambda m, _addr=addr, _now=now: self._on_remote_inputs(
+                    _addr, m, _now
+                ),
+            )
 
         self._check_desync()
         self._maybe_send_checksums(now)
@@ -218,10 +227,23 @@ class P2PSession:
             return NULL_FRAME
         return min(self._queues[h].last_confirmed_frame for h in handles)
 
-    def _on_remote_inputs(self, msg: proto.InputMsg) -> None:
+    def _on_remote_inputs(
+        self, sender: object, msg: proto.InputMsg, now: float
+    ) -> None:
         h = msg.handle
         if not 0 <= h < self.num_players or h not in self._handle_addr:
             return
+        owner = self._handle_addr[h]
+        relayed = sender != owner
+        if relayed:
+            # Handle-ownership check: a peer may only speak for its own
+            # players — except survivors relaying a DISCONNECTED player's
+            # confirmed inputs (see _relay_disconnected_inputs).
+            owner_ep = self._endpoints.get(owner)
+            if owner_ep is None or owner_ep.state != PeerState.DISCONNECTED:
+                return
+            if sender in self._spectator_addrs:
+                return  # spectators never contribute inputs
         queue = self._queues[h]
         for frame, bits in proto.unpack_input_span(
             msg, np.dtype(self._zero.dtype), self._zero.shape
@@ -232,15 +254,22 @@ class P2PSession:
                 break  # gap (loss beyond span) — wait for next resend
             queue.add_input(frame, bits)
             self._note_confirmed(h, frame, queue.confirmed(frame))
+        if relayed and queue.last_confirmed_frame >= 0:
+            # Relayed handles are outside the piggybacked-ack path: ack
+            # explicitly so the relaying survivor can trim its span.
+            self._endpoints[sender].send_input_ack(
+                h, queue.last_confirmed_frame, now
+            )
 
     def _note_confirmed(self, handle: int, frame: int, bits: np.ndarray) -> None:
         """A confirmed input arrived; if we already simulated ``frame`` with
-        a different prediction, schedule a rollback to it."""
+        different bits (a prediction, or a disconnect-freeze later corrected
+        by a surviving peer's relay), schedule a rollback to it."""
         used = self._used.get(frame)
         if used is None:
             return
         used_bits, used_status = used
-        if used_status[handle] == PREDICTED and not np.array_equal(
+        if used_status[handle] != CONFIRMED and not np.array_equal(
             used_bits[handle], bits
         ):
             if self._first_incorrect == NULL_FRAME or frame < self._first_incorrect:
@@ -248,11 +277,31 @@ class P2PSession:
 
     def _on_peer_disconnected(self, addr: object) -> None:
         """All handles at ``addr`` become disconnected: their inputs freeze
-        at repeat-last (== our prediction, so no rollback is needed) with
-        DISCONNECTED status from here on."""
+        at repeat-last with DISCONNECTED status. Because peers may have
+        received different amounts of the dead player's input (loss/latency
+        asymmetry), each survivor relays the confirmed tail it holds to the
+        others; later-arriving relayed inputs trigger a normal corrective
+        rollback via ``_note_confirmed``, so survivors converge on the
+        longest available history instead of desyncing."""
         for h, a in self._handle_addr.items():
             if a == addr and h not in self._disconnected:
                 self._disconnected[h] = self.current_frame
+                self._relay_disconnected_inputs(h)
+
+    def _relay_disconnected_inputs(self, handle: int) -> None:
+        queue = self._queues[handle]
+        dead_addr = self._handle_addr[handle]
+        spectators = set(self._spectator_addrs)
+        horizon = max(0, self.current_frame - self.max_prediction - 1)
+        for addr, ep in self._endpoints.items():
+            if addr == dead_addr or addr in spectators:
+                continue
+            if ep.state == PeerState.DISCONNECTED:
+                continue
+            for f in range(horizon, queue.last_confirmed_frame + 1):
+                got = queue.confirmed(f)
+                if got is not None:
+                    ep.queue_input(handle, f, got, relay=True)
 
     def disconnect_player(self, handle: int) -> None:
         """Voluntarily drop a remote player (ggrs ``disconnect_player``)."""
@@ -261,8 +310,9 @@ class P2PSession:
             raise InvalidRequest(f"handle {handle} is not remote")
         ep = self._endpoints[addr]
         if ep.state != PeerState.DISCONNECTED:
-            ep.state = PeerState.DISCONNECTED
-            self._events.append(SessionEvent(EventKind.DISCONNECTED, addr=addr))
+            ep.force_disconnect()
+            self._events.extend(ep.events)
+            ep.events.clear()
         self._on_peer_disconnected(addr)
 
     # ------------------------------------------------------------------
@@ -362,6 +412,8 @@ class P2PSession:
             for addr, ep in self._endpoints.items():
                 if addr in spectators:
                     continue  # spectators get the confirmed fan-out instead
+                if ep.state == PeerState.DISCONNECTED:
+                    continue  # never queue to the dead — unbounded growth
                 for f in range(
                     max(0, target - (self._queues[h].delay or 0)), target + 1
                 ):
@@ -410,6 +462,15 @@ class P2PSession:
         confirmed = self.confirmed_frame()
         for addr in self._spectator_addrs:
             ep = self._endpoints[addr]
+            if confirmed - self._spec_sent[addr] > SPECTATOR_MAX_LAG:
+                # Too far behind (never synced, or stalled): drop it so the
+                # host stops retaining input history on its behalf.
+                ep.force_disconnect()
+            if ep.state != PeerState.RUNNING:
+                # Not synced yet: keep the cursor frozen instead of
+                # accumulating unsendable pending spans; on sync the full
+                # history streams from the cursor.
+                continue
             start = self._spec_sent[addr] + 1
             for f in range(start, confirmed + 1):
                 for h, q in enumerate(self._queues):
@@ -420,10 +481,24 @@ class P2PSession:
                         ep.queue_input(h, f, got)
             self._spec_sent[addr] = max(self._spec_sent[addr], confirmed)
 
+    def _spectator_floor(self) -> int:
+        """Oldest frame a live spectator still needs from the fan-out —
+        input history must not be GC'd past it."""
+        floor = None
+        for addr in self._spectator_addrs:
+            if self._endpoints[addr].state == PeerState.DISCONNECTED:
+                continue
+            cursor = self._spec_sent[addr] + 1
+            floor = cursor if floor is None else min(floor, cursor)
+        return floor if floor is not None else 2**31
+
     def _gc(self) -> None:
-        """Drop history that can no longer participate in a rollback."""
+        """Drop history that can no longer participate in a rollback or the
+        spectator fan-out."""
         horizon = min(
-            self.confirmed_frame(), self.current_frame - self.max_prediction - 1
+            self.confirmed_frame(),
+            self.current_frame - self.max_prediction - 1,
+            self._spectator_floor(),
         )
         for q in self._queues:
             q.discard_before(horizon)

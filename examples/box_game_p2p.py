@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""box_game P2P over UDP: two (or more) processes on localhost.
+
+CLI parity with the reference binary
+(`/root/reference/examples/box_game/box_game_p2p.rs:15-23`):
+``--local-port``, ``--players`` (with ``localhost`` marking the local
+slot), ``--spectators``. Session knobs mirror `box_game_p2p.rs:34-37`:
+12-frame max prediction window, 2-frame input delay.
+
+Terminal A:  python examples/box_game_p2p.py --local-port 7000 \
+                 --players localhost 127.0.0.1:7001 --frames 600
+Terminal B:  python examples/box_game_p2p.py --local-port 7001 \
+                 --players 127.0.0.1:7000 localhost --frames 600
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from box_game_common import (  # noqa: E402
+    add_common_args,
+    build_app,
+    force_platform,
+    make_stats_system,
+    print_events_system,
+    print_world,
+    scripted_input,
+)
+
+
+def parse_addr(s: str):
+    host, _, port = s.rpartition(":")
+    return (host or "127.0.0.1", int(port))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--local-port", type=int, required=True)
+    parser.add_argument("--players", nargs="+", required=True,
+                        help="player slots in handle order; 'localhost' = me")
+    parser.add_argument("--spectators", nargs="*", default=[],
+                        help="spectator addresses host:port")
+    parser.add_argument("--input-delay", type=int, default=2)
+    parser.add_argument("--max-prediction", type=int, default=12)
+    parser.add_argument("--disconnect-timeout", type=float, default=5.0,
+                        help="seconds of peer silence before disconnect")
+    add_common_args(parser)
+    args = parser.parse_args()
+    force_platform(args.platform)
+
+    from bevy_ggrs_tpu.app import SessionType
+    from bevy_ggrs_tpu.models import box_game
+    from bevy_ggrs_tpu.session import PlayerType, SessionBuilder
+    from bevy_ggrs_tpu.transport.udp import UdpSocket
+
+    num_players = len(args.players)
+    builder = (
+        SessionBuilder(box_game.INPUT_SPEC)
+        .with_num_players(num_players)
+        .with_max_prediction_window(args.max_prediction)
+        .with_input_delay(args.input_delay)
+        .with_fps(args.fps)
+        .with_disconnect_timeout(args.disconnect_timeout)
+    )
+    for handle, slot in enumerate(args.players):
+        if slot == "localhost":
+            builder.add_player(PlayerType.local(), handle)
+        else:
+            builder.add_player(PlayerType.remote(parse_addr(slot)), handle)
+    for i, spec in enumerate(args.spectators):
+        builder.add_player(PlayerType.spectator(parse_addr(spec)), num_players + i)
+
+    # Build (and JIT-compile) the app BEFORE binding the socket, so the
+    # handshake starts only when we can actually service it.
+    app = build_app(num_players, args.max_prediction, args.fps, scripted_input)
+    socket = UdpSocket.bind_to_port(args.local_port)
+    session = builder.start_p2p_session(socket)
+    app.insert_session(session, SessionType.P2P)
+    app.add_render_system(print_events_system)
+    app.add_render_system(make_stats_system())
+
+    dt = 1.0 / args.fps
+    for _ in range(args.frames):
+        t0 = time.monotonic()
+        app.update()
+        lead = dt - (time.monotonic() - t0)
+        if lead > 0:
+            time.sleep(lead)
+    print_world(app, f"p2p done after {app.frame} sim frames "
+                     f"(rollbacks={app.stage.runner.rollbacks_total}, "
+                     f"resimulated={app.stage.runner.rollback_frames_total})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""box_game SyncTest: the determinism harness, headless.
+
+CLI parity with the reference binary
+(`/root/reference/examples/box_game/box_game_synctest.rs:13-19`):
+``--num-players``, ``--check-distance``. Every simulated frame forces a
+rollback ``check_distance`` frames deep and re-simulates; any checksum
+mismatch between the original and resimulated pass aborts with a desync
+error. Exits 0 with a final world printout when the run stays deterministic.
+
+    python examples/box_game_synctest.py --num-players 2 --check-distance 7
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from box_game_common import (  # noqa: E402
+    add_common_args,
+    build_app,
+    force_platform,
+    print_world,
+    scripted_input,
+)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--num-players", type=int, default=2)
+    parser.add_argument("--check-distance", type=int, default=2)
+    add_common_args(parser)
+    args = parser.parse_args()
+    force_platform(args.platform)
+
+    from bevy_ggrs_tpu.app import SessionType
+    from bevy_ggrs_tpu.models import box_game
+    from bevy_ggrs_tpu.session import MismatchedChecksum, SessionBuilder
+
+    session = (
+        SessionBuilder(box_game.INPUT_SPEC)
+        .with_num_players(args.num_players)
+        .with_check_distance(args.check_distance)
+        .with_max_prediction_window(max(8, args.check_distance))
+        .start_synctest_session()
+    )
+    app = build_app(args.num_players, max(8, args.check_distance), args.fps,
+                    scripted_input)
+    app.insert_session(session, SessionType.SYNC_TEST)
+
+    try:
+        app.run_for(args.frames, dt=1.0 / args.fps)
+    except MismatchedChecksum as exc:
+        print(f"DESYNC: {exc}", file=sys.stderr)
+        return 1
+    print_world(app, f"synctest ok after {app.frame} frames")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
