@@ -14,6 +14,7 @@ where ``vs_baseline`` > 1 means faster than the 16 ms budget.
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import jax
@@ -26,7 +27,22 @@ PLAYERS = 2
 BUDGET_MS = 16.0
 
 
+def _ensure_backend() -> str:
+    """Use the default (TPU) backend when it comes up; fall back to CPU so a
+    busy/unreachable pool still yields a benchmark line instead of a crash."""
+    try:
+        return jax.devices()[0].platform
+    except Exception as exc:  # backend init failed (e.g. UNAVAILABLE claim)
+        print(f"bench: TPU backend unavailable ({exc}); falling back to CPU",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()[0].platform
+
+
 def main() -> None:
+    platform = _ensure_backend()
+    print(f"bench: running on {platform}", file=sys.stderr)
+
     from bevy_ggrs_tpu.models import box_game
     from bevy_ggrs_tpu.parallel.speculate import (
         SpeculativeExecutor,

@@ -147,7 +147,11 @@ class GGRSStage:
         max_prediction: int,
         update_frequency: int = DEFAULT_FPS,
         clock=None,
+        metrics=None,
     ):
+        from bevy_ggrs_tpu.utils.metrics import null_metrics
+
+        self.metrics = metrics if metrics is not None else null_metrics
         self.input_system = input_system
         self.update_frequency = int(update_frequency)
         self.runner = RollbackRunner(
@@ -156,6 +160,7 @@ class GGRSStage:
             max_prediction=max_prediction,
             num_players=num_players,
             input_spec=input_spec,
+            metrics=self.metrics,
         )
         self._clock = clock if clock is not None else _time.monotonic
         # Compile the rollout executable now, before any session handshake:
@@ -195,7 +200,8 @@ class GGRSStage:
         # Pump the network every render frame, unconditionally
         # (`ggrs_stage.rs:113-119`).
         if app.session_type in (SessionType.P2P, SessionType.SPECTATOR):
-            app.session.poll_remote_clients(now)
+            with self.metrics.timer("poll"):
+                app.session.poll_remote_clients(now)
             app.events.extend(app.session.events())
 
         self.accumulator += delta
@@ -260,6 +266,7 @@ class GGRSPlugin:
         self.num_players = 2
         self._setup: Optional[Callable[[HostWorld, RollbackApp], None]] = None
         self.clock = None
+        self.metrics = None
 
     def with_update_frequency(self, fps: int) -> "GGRSPlugin":
         self.update_frequency = int(fps)
@@ -311,6 +318,12 @@ class GGRSPlugin:
         self.clock = clock
         return self
 
+    def with_metrics(self, metrics) -> "GGRSPlugin":
+        """Install a :class:`bevy_ggrs_tpu.utils.metrics.Metrics` sink for
+        per-phase timings and rollback histograms."""
+        self.metrics = metrics
+        return self
+
     def build(self, app: Optional[RollbackApp] = None) -> RollbackApp:
         if self.input_system is None:
             # Parity with the reference's explicit panic (`lib.rs:157-159`).
@@ -328,5 +341,6 @@ class GGRSPlugin:
             max_prediction=self.max_prediction,
             update_frequency=self.update_frequency,
             clock=self.clock,
+            metrics=self.metrics,
         )
         return app

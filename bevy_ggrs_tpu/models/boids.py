@@ -1,0 +1,213 @@
+"""Boids flocking: the entity-count scaling model (BASELINE.md config 4).
+
+Unlike box_game (`/root/reference/examples/box_game/box_game.rs`) whose
+entities are independent given inputs, boids couple ALL entities through the
+classic separation/alignment/cohesion rules — an O(N²) pairwise interaction
+per frame. That makes it:
+
+- the entity-count stress model (1k+ rollback-tagged entities, each with
+  Transform+Velocity, per BASELINE.md config 4), and
+- the model-parallel showcase: the pairwise force matrix shards over the
+  mesh's ``entity`` axis (each shard computes its rows against an
+  all-gathered position set — the TP analog), composing with branch-axis
+  data parallelism.
+
+Players steer flock "leaders" with the same u8 input bitmask as box_game, so
+the full session machinery (prediction, rollback, checksums) applies
+unchanged.
+
+Determinism note: all reductions are fixed-order ``sum`` over a static
+entity axis — bit-reproducible under XLA on a given platform, which is what
+the SyncTest harness checks.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from bevy_ggrs_tpu.schedule import InputSpec, PlayerInputs, Schedule
+from bevy_ggrs_tpu.state import HostWorld, TypeRegistry, WorldState
+
+INPUT_UP = 1 << 0
+INPUT_DOWN = 1 << 1
+INPUT_LEFT = 1 << 2
+INPUT_RIGHT = 1 << 3
+
+INPUT_SPEC = InputSpec(shape=(), dtype=jnp.uint8)
+
+# Flocking parameters (2D plane).
+NEIGHBOR_RADIUS = 1.0
+SEPARATION_RADIUS = 0.35
+W_SEPARATION = jnp.float32(0.08)
+W_ALIGNMENT = jnp.float32(0.05)
+W_COHESION = jnp.float32(0.03)
+W_LEADER = jnp.float32(0.06)
+LEADER_STEER = jnp.float32(0.02)
+MAX_SPEED = jnp.float32(0.08)
+MIN_SPEED = jnp.float32(0.02)
+WORLD_HALF = jnp.float32(8.0)
+
+
+def make_registry() -> TypeRegistry:
+    reg = TypeRegistry()
+    reg.register_component("position", shape=(2,), dtype=jnp.float32)
+    reg.register_component("velocity", shape=(2,), dtype=jnp.float32)
+    # Leader boids carry the player handle steering them; -1 = flock member.
+    reg.register_component("leader_handle", shape=(), dtype=jnp.int32, default=-1)
+    reg.register_resource("frame_count", jnp.uint32(0))
+    return reg
+
+
+def make_world(
+    num_boids: int,
+    num_players: int,
+    capacity: Optional[int] = None,
+    seed: int = 0,
+) -> HostWorld:
+    """``num_boids`` flock members on a deterministic spawn spiral; the
+    first ``num_players`` of them are player-steered leaders."""
+    capacity = num_boids if capacity is None else capacity
+    world = HostWorld(make_registry(), capacity)
+    rng = np.random.RandomState(seed)
+    for i in range(num_boids):
+        ang = i * 2.399963  # golden-angle spiral: deterministic, spread out
+        rad = 0.15 * math.sqrt(i + 1)
+        vel = rng.uniform(-0.03, 0.03, size=2).astype(np.float32)
+        world.spawn(
+            {
+                "position": np.array(
+                    [rad * math.cos(ang), rad * math.sin(ang)], dtype=np.float32
+                ),
+                "velocity": vel,
+                "leader_handle": np.int32(i if i < num_players else -1),
+            },
+            rollback_id=i,
+        )
+    return world
+
+
+def flock_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
+    """One flocking step: O(N²) pairwise separation/alignment/cohesion
+    forces + leader steering from player inputs, then clamped integration.
+
+    The pairwise part is a dense [N, N] interaction — on TPU this is MXU/VPU
+    work that a sharded variant splits by rows over the ``entity`` mesh axis
+    (see ``bevy_ggrs_tpu.parallel.entity_sharding``).
+    """
+    pos = state.components["position"]  # [N, 2]
+    vel = state.components["velocity"]
+    leader = state.components["leader_handle"]
+    active = (state.alive & state.present["position"]).astype(jnp.float32)  # [N]
+
+    force = _pairwise_forces(pos, vel, active)
+
+    # Leader steering (player inputs), box_game-style exclusive keys.
+    num_players = inputs.num_players
+    safe = jnp.clip(leader, 0, num_players - 1)
+    bits = inputs.bits[safe].astype(jnp.uint32)
+    is_leader = (leader >= 0) & state.alive
+    steer_x = (
+        ((bits & INPUT_RIGHT) != 0).astype(jnp.float32)
+        - ((bits & INPUT_LEFT) != 0).astype(jnp.float32)
+    )
+    steer_y = (
+        ((bits & INPUT_DOWN) != 0).astype(jnp.float32)
+        - ((bits & INPUT_UP) != 0).astype(jnp.float32)
+    )
+    steer = jnp.stack([steer_x, steer_y], axis=1) * LEADER_STEER
+    force = force + jnp.where(is_leader[:, None], steer, 0.0)
+
+    new_vel = vel + force
+    # Speed clamp to [MIN_SPEED, MAX_SPEED].
+    speed = jnp.sqrt(jnp.sum(new_vel * new_vel, axis=1, keepdims=True))
+    speed_safe = jnp.maximum(speed, jnp.float32(1e-6))
+    clamped = jnp.clip(speed_safe, MIN_SPEED, MAX_SPEED)
+    new_vel = new_vel * (clamped / speed_safe)
+
+    new_pos = pos + new_vel
+    # Toroidal wrap keeps the flock bounded without wall dynamics.
+    new_pos = jnp.where(new_pos > WORLD_HALF, new_pos - 2 * WORLD_HALF, new_pos)
+    new_pos = jnp.where(new_pos < -WORLD_HALF, new_pos + 2 * WORLD_HALF, new_pos)
+
+    sel = (state.alive & state.present["position"] & state.present["velocity"])[
+        :, None
+    ]
+    return state.replace(
+        components={
+            **state.components,
+            "position": jnp.where(sel, new_pos, pos),
+            "velocity": jnp.where(sel, new_vel, vel),
+        }
+    )
+
+
+def _pairwise_forces(
+    pos: jnp.ndarray, vel: jnp.ndarray, active: jnp.ndarray
+) -> jnp.ndarray:
+    """Dense all-pairs flocking forces for rows [N] against columns [N].
+
+    Factored out so the entity-sharded variant can compute row blocks
+    against the full (all-gathered) column set.
+    """
+    return pairwise_force_rows(pos, vel, pos, vel, active, active)
+
+
+def pairwise_force_rows(
+    row_pos: jnp.ndarray,  # [R, 2] — the rows this shard owns
+    row_vel: jnp.ndarray,  # [R, 2]
+    all_pos: jnp.ndarray,  # [N, 2] — every boid (gathered)
+    all_vel: jnp.ndarray,  # [N, 2]
+    row_active: jnp.ndarray,  # float[R]
+    all_active: jnp.ndarray,  # float[N]
+) -> jnp.ndarray:
+    """Separation/alignment/cohesion force on each row boid from all boids.
+
+    Self-interaction is annihilated by the distance-zero mask on separation
+    and by excluding d≈0 from the neighborhood.
+    """
+    diff = row_pos[:, None, :] - all_pos[None, :, :]  # [R, N, 2]
+    d2 = jnp.sum(diff * diff, axis=2)  # [R, N]
+    d = jnp.sqrt(jnp.maximum(d2, jnp.float32(1e-12)))
+
+    both = row_active[:, None] * all_active[None, :]
+    is_self = d2 < jnp.float32(1e-10)
+    neigh = (
+        both
+        * (d < jnp.float32(NEIGHBOR_RADIUS)).astype(jnp.float32)
+        * (1.0 - is_self.astype(jnp.float32))
+    )  # [R, N]
+    n_neigh = jnp.sum(neigh, axis=1, keepdims=True)  # [R, 1]
+    n_safe = jnp.maximum(n_neigh, jnp.float32(1.0))
+
+    # Separation: push away from too-close neighbors, 1/d weighted.
+    close = neigh * (d < jnp.float32(SEPARATION_RADIUS)).astype(jnp.float32)
+    sep = jnp.sum(diff / d[:, :, None] * close[:, :, None], axis=1)
+
+    # Alignment: match neighborhood mean velocity.
+    mean_vel = jnp.sum(all_vel[None, :, :] * neigh[:, :, None], axis=1) / n_safe
+    align = jnp.where(n_neigh > 0, mean_vel - row_vel, 0.0)
+
+    # Cohesion: steer toward neighborhood centroid.
+    mean_pos = jnp.sum(all_pos[None, :, :] * neigh[:, :, None], axis=1) / n_safe
+    coh = jnp.where(n_neigh > 0, mean_pos - row_pos, 0.0)
+
+    force = W_SEPARATION * sep + W_ALIGNMENT * align + W_COHESION * coh
+    return force * row_active[:, None]
+
+
+def increase_frame_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
+    del inputs
+    return state.replace(
+        resources={
+            **state.resources,
+            "frame_count": state.resources["frame_count"] + jnp.uint32(1),
+        }
+    )
+
+
+def make_schedule() -> Schedule:
+    return Schedule([flock_system, increase_frame_system])

@@ -1,0 +1,6 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Currently: the batched UDP poller (`udp_poller.cpp`) used by
+:mod:`bevy_ggrs_tpu.transport.udp` when available. Build is lazy and
+failure-tolerant — the pure-Python path is the fallback.
+"""

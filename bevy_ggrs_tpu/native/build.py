@@ -1,0 +1,35 @@
+"""Build the native UDP poller shared library (g++, no pybind11).
+
+Invoked lazily on first import of :mod:`bevy_ggrs_tpu.native.udp`; the
+result is cached next to the source as ``_ggrs_udp.so``. Failure to build
+(no toolchain, exotic platform) is non-fatal — the pure-Python socket path
+in :mod:`bevy_ggrs_tpu.transport.udp` serves as fallback.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_DIR, "udp_poller.cpp")
+LIB = os.path.join(_DIR, "_ggrs_udp.so")
+
+
+def ensure_built(force: bool = False) -> str:
+    """Compile if missing/stale; returns the .so path. Raises on failure."""
+    if (
+        not force
+        and os.path.exists(LIB)
+        and os.path.getmtime(LIB) >= os.path.getmtime(SRC)
+    ):
+        return LIB
+    tmp = LIB + ".tmp"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", SRC, "-o", tmp]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(tmp, LIB)
+    return LIB
+
+
+if __name__ == "__main__":
+    print(ensure_built(force=True))

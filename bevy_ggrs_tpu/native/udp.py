@@ -1,0 +1,82 @@
+"""ctypes bindings for the native UDP poller.
+
+Exposes :class:`NativeUdpSocket` with the ``NonBlockingSocket`` interface
+(`bevy_ggrs_tpu.transport.socket`). One ``recvmmsg`` syscall drains up to a
+whole batch of datagrams; the Python side slices payloads out of a single
+preallocated flat buffer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import socket as _socket
+import struct
+from typing import List, Tuple
+
+from bevy_ggrs_tpu.native.build import ensure_built
+
+_lib = ctypes.CDLL(ensure_built())
+_lib.ggrs_udp_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+_lib.ggrs_udp_create.restype = ctypes.c_int
+_lib.ggrs_udp_send.argtypes = [
+    ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+]
+_lib.ggrs_udp_send.restype = ctypes.c_int
+_lib.ggrs_udp_recv_batch.argtypes = [
+    ctypes.c_int, ctypes.POINTER(ctypes.c_uint8),
+    ctypes.c_int, ctypes.POINTER(ctypes.c_uint8),
+    ctypes.POINTER(ctypes.c_int32),
+]
+_lib.ggrs_udp_recv_batch.restype = ctypes.c_int
+_lib.ggrs_udp_slot_size.restype = ctypes.c_int
+_lib.ggrs_udp_max_batch.restype = ctypes.c_int
+_lib.ggrs_udp_close.argtypes = [ctypes.c_int]
+
+_SLOT = int(_lib.ggrs_udp_slot_size())
+_BATCH = int(_lib.ggrs_udp_max_batch())
+
+
+class NativeUdpSocket:
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        fd = _lib.ggrs_udp_create(host.encode(), int(port))
+        if fd < 0:
+            raise OSError(-fd, f"ggrs_udp_create({host}, {port})")
+        self._fd = fd
+        self._buf = (ctypes.c_uint8 * (_BATCH * _SLOT))()
+        self._addrs = (ctypes.c_uint8 * (_BATCH * 6))()
+        self._lens = (ctypes.c_int32 * _BATCH)()
+
+    def send_to(self, msg: bytes, addr: Tuple[str, int]) -> None:
+        buf = (ctypes.c_uint8 * len(msg)).from_buffer_copy(msg)
+        _lib.ggrs_udp_send(self._fd, addr[0].encode(), int(addr[1]), buf, len(msg))
+
+    def receive_all(self) -> List[Tuple[Tuple[str, int], bytes]]:
+        out: List[Tuple[Tuple[str, int], bytes]] = []
+        while True:
+            n = _lib.ggrs_udp_recv_batch(
+                self._fd, self._buf, _BATCH, self._addrs, self._lens
+            )
+            if n <= 0:
+                break
+            raw = bytes(self._buf)
+            araw = bytes(self._addrs)
+            for i in range(n):
+                ip = _socket.inet_ntoa(araw[i * 6 : i * 6 + 4])
+                port = struct.unpack("!H", araw[i * 6 + 4 : i * 6 + 6])[0]
+                payload = raw[i * _SLOT : i * _SLOT + self._lens[i]]
+                out.append(((ip, port), payload))
+            if n < _BATCH:
+                break  # drained within one batch
+        return out
+
+    def close(self) -> None:
+        if self._fd >= 0:
+            _lib.ggrs_udp_close(self._fd)
+            self._fd = -1
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,116 @@
+// Native UDP transport: batched non-blocking datagram I/O.
+//
+// The reference's transport is ggrs's UdpNonBlockingSocket (Rust; used at
+// /root/reference/examples/box_game/box_game_p2p.rs:57) — a non-blocking
+// socket drained once per render frame. At 60 Hz with several peers +
+// spectators, a pure-Python drain pays one interpreter round-trip and one
+// syscall per datagram; this poller drains the socket with recvmmsg (one
+// syscall per BATCH) into a flat buffer the Python side slices without
+// copies. C ABI only — loaded via ctypes (no pybind11 in this image).
+//
+// Build: bevy_ggrs_tpu/native/build.py (g++ -O2 -shared -fPIC).
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+namespace {
+constexpr int kMaxBatch = 64;
+constexpr int kSlotSize = 2048;  // fixed per-message slot in the flat buffer
+}  // namespace
+
+extern "C" {
+
+// Create + bind a non-blocking UDP socket. Returns fd, or -errno.
+int ggrs_udp_create(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_DGRAM, 0);
+  if (fd < 0) return -errno;
+  int flags = ::fcntl(fd, F_GETFL, 0);
+  if (flags < 0 || ::fcntl(fd, F_SETFL, flags | O_NONBLOCK) < 0) {
+    int e = errno;
+    ::close(fd);
+    return -e;
+  }
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -EINVAL;
+  }
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    int e = errno;
+    ::close(fd);
+    return -e;
+  }
+  return fd;
+}
+
+// Non-blocking send. Returns bytes sent, 0 on transient backpressure
+// (EAGAIN — the non-blocking contract is drop, matching the Python path),
+// or -errno on hard errors.
+int ggrs_udp_send(int fd, const char* ip, int port, const uint8_t* buf,
+                  int len) {
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, ip, &addr.sin_addr) != 1) return -EINVAL;
+  ssize_t n = ::sendto(fd, buf, static_cast<size_t>(len), MSG_DONTWAIT,
+                       reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  if (n < 0) {
+    if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) return 0;
+    return -errno;
+  }
+  return static_cast<int>(n);
+}
+
+// Drain up to max_msgs datagrams in ONE recvmmsg syscall.
+//   buf:   caller buffer of max_msgs * 2048 bytes; message i occupies
+//          bytes [i*2048, i*2048+lens[i]).
+//   addrs: caller buffer of max_msgs * 6 bytes: ip4 (4, network order) +
+//          port (2, network order) per message.
+//   lens:  caller int32 buffer, payload length per message.
+// Returns message count (0 = nothing pending), or -errno.
+int ggrs_udp_recv_batch(int fd, uint8_t* buf, int max_msgs, uint8_t* addrs,
+                        int32_t* lens) {
+  if (max_msgs > kMaxBatch) max_msgs = kMaxBatch;
+  mmsghdr msgs[kMaxBatch];
+  iovec iovs[kMaxBatch];
+  sockaddr_in srcs[kMaxBatch];
+  std::memset(msgs, 0, sizeof(mmsghdr) * static_cast<size_t>(max_msgs));
+  for (int i = 0; i < max_msgs; ++i) {
+    iovs[i].iov_base = buf + static_cast<size_t>(i) * kSlotSize;
+    iovs[i].iov_len = kSlotSize;
+    msgs[i].msg_hdr.msg_iov = &iovs[i];
+    msgs[i].msg_hdr.msg_iovlen = 1;
+    msgs[i].msg_hdr.msg_name = &srcs[i];
+    msgs[i].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+  }
+  int n = ::recvmmsg(fd, msgs, static_cast<unsigned>(max_msgs), MSG_DONTWAIT,
+                     nullptr);
+  if (n < 0) {
+    if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) return 0;
+    return -errno;
+  }
+  for (int i = 0; i < n; ++i) {
+    lens[i] = static_cast<int32_t>(msgs[i].msg_len);
+    std::memcpy(addrs + i * 6, &srcs[i].sin_addr.s_addr, 4);
+    std::memcpy(addrs + i * 6 + 4, &srcs[i].sin_port, 2);
+  }
+  return n;
+}
+
+int ggrs_udp_slot_size() { return kSlotSize; }
+int ggrs_udp_max_batch() { return kMaxBatch; }
+
+void ggrs_udp_close(int fd) { ::close(fd); }
+
+}  // extern "C"

@@ -64,3 +64,57 @@ def branch_pspec(mesh: Mesh, branch_axis: str = "branch") -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+# ---------------------------------------------------------------------------
+# Entity-axis (model-parallel analog) sharding of world-state pytrees
+# ---------------------------------------------------------------------------
+
+
+def world_pspecs(state, entity_axis: Optional[str] = None):
+    """PartitionSpec tree for a :class:`~bevy_ggrs_tpu.state.WorldState`:
+    every leaf with a leading ``capacity`` axis is split over
+    ``entity_axis`` (or replicated when None); resources replicate.
+
+    With these annotations, coupled systems (e.g. the boids all-pairs
+    forces) need no manual collectives: GSPMD propagates the sharding
+    through the [N, N] interaction and inserts the all-gathers/reductions
+    itself — the scaling-book recipe (annotate, compile, profile).
+    """
+    cap = state.capacity
+
+    def spec(x):
+        if (
+            entity_axis is not None
+            and hasattr(x, "ndim")
+            and x.ndim >= 1
+            and x.shape[0] == cap
+        ):
+            return P(entity_axis)
+        return P()
+
+    return jax.tree_util.tree_map(spec, state)
+
+
+def prepend_axes(specs_tree, *axes):
+    """Prefix every PartitionSpec in the tree with ``axes`` (e.g. a leading
+    ring-depth ``None`` or a ``"branch"`` batch axis)."""
+    return jax.tree_util.tree_map(
+        lambda s: P(*axes, *s), specs_tree, is_leaf=lambda s: isinstance(s, P)
+    )
+
+
+def to_named(specs_tree, mesh: Mesh):
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        specs_tree,
+        is_leaf=lambda s: isinstance(s, P),
+    )
+
+
+def shard_world(state, mesh: Mesh, entity_axis: str = "entity"):
+    """Lay a world state out with its entity (capacity) axis split over the
+    mesh's entity axis."""
+    return jax.tree_util.tree_map(
+        jax.device_put, state, to_named(world_pspecs(state, entity_axis), mesh)
+    )

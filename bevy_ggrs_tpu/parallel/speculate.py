@@ -164,27 +164,70 @@ class SpeculativeExecutor:
         max_frames: int,
         mesh=None,
         branch_axis: str = "branch",
+        entity_axis: Optional[str] = None,
+        state_template: Optional[WorldState] = None,
     ):
+        """With ``mesh`` alone, the branch axis is data-parallel across all
+        devices. Adding ``entity_axis`` (+ a ``state_template`` for leaf
+        structure) also splits the world's entity/capacity axis over that
+        mesh axis — the model-parallel analog for entity-coupled systems
+        (boids all-pairs forces): annotate, and GSPMD inserts the
+        gathers/reductions over ICI.
+        """
         self.schedule = schedule
         self.num_branches = int(num_branches)
         self.max_frames = int(max_frames)
         self.mesh = mesh
         self.branch_axis = branch_axis
+        self.entity_axis = entity_axis
 
         run = functools.partial(self._run_impl, schedule, self.max_frames)
         commit = self._commit_impl
         if mesh is not None:
-            from bevy_ggrs_tpu.parallel.sharding import branch_pspec, replicated
+            from jax.sharding import PartitionSpec as P
+
+            from bevy_ggrs_tpu.parallel.sharding import (
+                branch_pspec,
+                prepend_axes,
+                replicated,
+                to_named,
+                world_pspecs,
+            )
 
             spec_b = branch_pspec(mesh, branch_axis)
             rep = replicated(mesh)
-            # state, frame, bits, status replicated in; branch-stacked out.
-            self._run = jax.jit(
-                run,
-                in_shardings=(rep, rep, spec_b, rep),
-                out_shardings=(spec_b, spec_b, spec_b),
-            )
-            self._commit = jax.jit(commit, out_shardings=rep)
+            if entity_axis is not None:
+                if state_template is None:
+                    raise ValueError(
+                        "entity_axis sharding needs a state_template"
+                    )
+                sspec = world_pspecs(state_template, entity_axis)
+                state_in = to_named(sspec, mesh)
+                states_out = to_named(
+                    prepend_axes(sspec, branch_axis), mesh
+                )
+                rings_out = SnapshotRing(
+                    states=to_named(
+                        prepend_axes(sspec, branch_axis, None), mesh
+                    ),
+                    frames=branch_pspec(mesh, branch_axis),
+                    checksums=branch_pspec(mesh, branch_axis),
+                )
+                self._run = jax.jit(
+                    run,
+                    in_shardings=(state_in, rep, spec_b, rep),
+                    out_shardings=(rings_out, states_out, spec_b),
+                )
+                # Let GSPMD pick commit's output layout (entity stays split).
+                self._commit = jax.jit(commit)
+            else:
+                # state, frame, bits, status replicated in; branch-stacked out.
+                self._run = jax.jit(
+                    run,
+                    in_shardings=(rep, rep, spec_b, rep),
+                    out_shardings=(spec_b, spec_b, spec_b),
+                )
+                self._commit = jax.jit(commit, out_shardings=rep)
         else:
             self._run = jax.jit(run)
             self._commit = jax.jit(commit)

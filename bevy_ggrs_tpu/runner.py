@@ -50,7 +50,11 @@ class RollbackRunner:
         num_players: int,
         input_spec,
         report_checksums: bool = True,
+        metrics=None,
     ):
+        from bevy_ggrs_tpu.utils.metrics import null_metrics
+
+        self.metrics = metrics if metrics is not None else null_metrics
         self.schedule = schedule
         self.state = initial_state
         self.num_players = int(num_players)
@@ -137,25 +141,32 @@ class RollbackRunner:
             )
             save_mask = np.array([s.save_frame is not None for s in steps])
             adv_mask = np.array([s.adv is not None for s in steps])
-            self.ring, self.state, checksums = self.executor.run(
-                self.ring,
-                self.state,
-                start_frame,
-                bits,
-                status,
-                n_frames=n,
-                load_frame=load_frame,
-                save_mask=save_mask,
-                adv_mask=adv_mask,
-            )
+            with self.metrics.timer("dispatch"):
+                self.ring, self.state, checksums = self.executor.run(
+                    self.ring,
+                    self.state,
+                    start_frame,
+                    bits,
+                    status,
+                    n_frames=n,
+                    load_frame=load_frame,
+                    save_mask=save_mask,
+                    adv_mask=adv_mask,
+                )
             if session is not None and self.report_checksums and save_mask.any():
-                cs_host = np.asarray(checksums)
+                with self.metrics.timer("checksum_sync"):
+                    cs_host = np.asarray(checksums)
                 for t, sf in enumerate(save_frames):
                     if sf is not None:
                         session.report_checksum(sf, int(cs_host[t]))
+        self.metrics.count("frames_advanced", sum(1 for s in steps if s.adv))
         if load_frame is not None:
+            depth = sum(1 for s in steps if s.adv is not None)
             self.rollbacks_total += 1
-            self.rollback_frames_total += sum(1 for s in steps if s.adv is not None)
+            self.rollback_frames_total += depth
+            self.metrics.count("rollbacks")
+            self.metrics.count("rollback_frames", depth)
+            self.metrics.observe("rollback_depth", depth)
         self.frame = frame
 
     # ------------------------------------------------------------------

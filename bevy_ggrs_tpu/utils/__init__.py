@@ -1,0 +1,1 @@
+from bevy_ggrs_tpu.utils.metrics import Metrics, Timer, null_metrics

@@ -1,0 +1,142 @@
+"""Observability: counters, per-phase timers, rollback-depth histograms.
+
+The reference ships only `log`-crate warnings (survey §5: "no spans, no
+profiler hooks"); its observables are session events + network stats. This
+module adds the quantitative layer the TPU build needs:
+
+- per-phase wall timing (network poll / input collection / device dispatch /
+  host sync) over the stage loop,
+- rollback depth + resimulated-frame histograms (the misprediction-recovery
+  cost distribution — the BASELINE.md p99 metric),
+- throughput counters (frames, rollback-frames, branches) with rate
+  reporting.
+
+All instruments are no-ops through :data:`null_metrics` unless a real
+:class:`Metrics` is installed, so the hot loop pays one attribute lookup
+when disabled. For kernel-level profiles, wrap a run with
+``jax.profiler.trace(logdir)`` — these host-side metrics and the XLA
+profile compose.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Dict, List, Optional
+
+
+class Timer:
+    """Context-manager phase timer: ``with metrics.timer("dispatch"): ...``"""
+
+    __slots__ = ("_metrics", "_name", "_t0")
+
+    def __init__(self, metrics: "Metrics", name: str):
+        self._metrics = metrics
+        self._name = name
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._metrics.observe(
+            self._name, (time.perf_counter() - self._t0) * 1000.0
+        )
+        return False
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self.counters: Dict[str, float] = collections.defaultdict(float)
+        self.series: Dict[str, List[float]] = collections.defaultdict(list)
+        self._created = time.perf_counter()
+
+    # -- instruments ----------------------------------------------------
+
+    def count(self, name: str, n: float = 1) -> None:
+        self.counters[name] += n
+
+    def observe(self, name: str, value: float) -> None:
+        s = self.series[name]
+        s.append(float(value))
+        if len(s) > 100_000:  # bound memory on long sessions
+            del s[: len(s) // 2]
+
+    def timer(self, name: str) -> Timer:
+        return Timer(self, f"{name}_ms")
+
+    # -- reporting ------------------------------------------------------
+
+    @staticmethod
+    def _percentile(sorted_vals: List[float], q: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+        return sorted_vals[idx]
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-series {count, mean, p50, p95, p99, max} + raw counters +
+        uptime-normalized rates."""
+        out: Dict[str, Dict[str, float]] = {}
+        for name, vals in self.series.items():
+            sv = sorted(vals)
+            out[name] = {
+                "count": len(sv),
+                "mean": sum(sv) / len(sv) if sv else 0.0,
+                "p50": self._percentile(sv, 0.50),
+                "p95": self._percentile(sv, 0.95),
+                "p99": self._percentile(sv, 0.99),
+                "max": sv[-1] if sv else 0.0,
+            }
+        elapsed = max(time.perf_counter() - self._created, 1e-9)
+        for name, val in self.counters.items():
+            out[name] = {"total": val, "per_sec": val / elapsed}
+        return out
+
+    def report(self) -> str:
+        lines = []
+        for name, stats in sorted(self.summary().items()):
+            body = " ".join(
+                f"{k}={v:.3f}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in stats.items()
+            )
+            lines.append(f"{name}: {body}")
+        return "\n".join(lines)
+
+
+class _NullTimer:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _NullMetrics(Metrics):
+    """Shared no-op sink; every instrument call is O(1) and allocation-free."""
+
+    _timer = _NullTimer()
+
+    def __init__(self) -> None:  # no dict churn
+        pass
+
+    def count(self, name: str, n: float = 1) -> None:
+        pass
+
+    def observe(self, name: str, value: float) -> None:
+        pass
+
+    def timer(self, name: str) -> _NullTimer:  # type: ignore[override]
+        return self._timer
+
+    def summary(self):
+        return {}
+
+    def report(self) -> str:
+        return "(metrics disabled)"
+
+
+null_metrics = _NullMetrics()

@@ -1,0 +1,66 @@
+"""Observability: metrics instruments + integration with runner/stage."""
+
+import numpy as np
+
+from bevy_ggrs_tpu.utils.metrics import Metrics, null_metrics
+
+
+class TestInstruments:
+    def test_counters_and_series(self):
+        m = Metrics()
+        m.count("frames", 3)
+        m.count("frames", 2)
+        for v in [1.0, 2.0, 3.0, 4.0, 100.0]:
+            m.observe("depth", v)
+        s = m.summary()
+        assert s["frames"]["total"] == 5
+        assert s["depth"]["count"] == 5
+        assert s["depth"]["max"] == 100.0
+        assert s["depth"]["p50"] == 3.0
+        assert "depth" in m.report()
+
+    def test_timer_records_ms(self):
+        m = Metrics()
+        with m.timer("phase"):
+            pass
+        assert m.summary()["phase_ms"]["count"] == 1
+
+    def test_null_metrics_noop(self):
+        null_metrics.count("x")
+        null_metrics.observe("y", 1.0)
+        with null_metrics.timer("z"):
+            pass
+        assert null_metrics.summary() == {}
+
+
+class TestIntegration:
+    def test_rollback_histogram_via_synctest(self):
+        from bevy_ggrs_tpu.models import box_game
+        from bevy_ggrs_tpu.runner import RollbackRunner
+        from bevy_ggrs_tpu.session import SessionBuilder
+
+        m = Metrics()
+        session = (
+            SessionBuilder(box_game.INPUT_SPEC)
+            .with_num_players(2)
+            .with_check_distance(3)
+            .start_synctest_session()
+        )
+        runner = RollbackRunner(
+            box_game.make_schedule(),
+            box_game.make_world(2).commit(),
+            8, 2, box_game.INPUT_SPEC,
+            metrics=m,
+        )
+        rng = np.random.RandomState(0)
+        for _ in range(10):
+            for h in range(2):
+                session.add_local_input(h, np.uint8(rng.randint(0, 16)))
+            runner.handle_requests(session.advance_frame(), session)
+        s = m.summary()
+        assert s["rollbacks"]["total"] > 0
+        assert s["rollback_depth"]["count"] == s["rollbacks"]["total"]
+        # check_distance=3 → forced rollbacks resimulate 4 frames each.
+        assert s["rollback_depth"]["max"] == 4
+        assert s["dispatch_ms"]["count"] > 0
+        assert s["frames_advanced"]["total"] > 10

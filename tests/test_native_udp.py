@@ -1,0 +1,69 @@
+"""Native (C++) UDP poller: build, batch drain, transport integration."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("bevy_ggrs_tpu.native.udp", reason="native toolchain unavailable")
+
+from bevy_ggrs_tpu.native.udp import NativeUdpSocket
+from bevy_ggrs_tpu.transport.udp import UdpSocket
+
+
+def free_pair(base=17510):
+    return base, base + 1
+
+
+class TestNativeUdp:
+    def test_roundtrip_order_and_addr(self):
+        pa, pb = free_pair(17520)
+        a, b = NativeUdpSocket(port=pa), NativeUdpSocket(port=pb)
+        try:
+            for i in range(10):
+                a.send_to(bytes([i]) * (i + 1), ("127.0.0.1", pb))
+            import time
+
+            time.sleep(0.05)
+            got = b.receive_all()
+            assert [m for _, m in got] == [bytes([i]) * (i + 1) for i in range(10)]
+            assert all(addr == ("127.0.0.1", pa) for addr, _ in got)
+        finally:
+            a.close()
+            b.close()
+
+    def test_empty_drain(self):
+        s = NativeUdpSocket(port=17530)
+        try:
+            assert s.receive_all() == []
+        finally:
+            s.close()
+
+    def test_large_batch_single_poll(self):
+        """More datagrams than one recvmmsg batch still fully drain."""
+        pa, pb = free_pair(17540)
+        a, b = NativeUdpSocket(port=pa), NativeUdpSocket(port=pb)
+        try:
+            n = 150  # > kMaxBatch=64
+            for i in range(n):
+                a.send_to(i.to_bytes(2, "little"), ("127.0.0.1", pb))
+            import time
+
+            time.sleep(0.1)
+            got = b.receive_all()
+            assert len(got) == n
+            assert [int.from_bytes(m, "little") for _, m in got] == list(range(n))
+        finally:
+            a.close()
+            b.close()
+
+    def test_transport_uses_native(self):
+        s = UdpSocket(17550)
+        try:
+            assert s._native is not None, "UdpSocket should pick the native poller"
+            s.send_to(b"ping", ("127.0.0.1", 17550))
+            import time
+
+            time.sleep(0.05)
+            got = s.receive_all()
+            assert got and got[0][1] == b"ping"
+        finally:
+            s.close()
