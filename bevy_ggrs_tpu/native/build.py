@@ -1,9 +1,9 @@
-"""Build the native UDP poller shared library (g++, no pybind11).
+"""Build the native shared libraries (g++, no pybind11).
 
-Invoked lazily on first import of :mod:`bevy_ggrs_tpu.native.udp`; the
-result is cached next to the source as ``_ggrs_udp.so``. Failure to build
-(no toolchain, exotic platform) is non-fatal — the pure-Python socket path
-in :mod:`bevy_ggrs_tpu.transport.udp` serves as fallback.
+Each ``.cpp`` in this directory compiles to a sibling ``.so``, lazily on
+first import of its binding module and cached until the source changes.
+Failure to build (no toolchain, exotic platform) is non-fatal — every native
+component has a pure-Python fallback.
 """
 
 from __future__ import annotations
@@ -14,22 +14,41 @@ import subprocess
 _DIR = os.path.dirname(os.path.abspath(__file__))
 SRC = os.path.join(_DIR, "udp_poller.cpp")
 LIB = os.path.join(_DIR, "_ggrs_udp.so")
+CORE_SRC = os.path.join(_DIR, "session_core.cpp")
+CORE_LIB = os.path.join(_DIR, "_ggrs_core.so")
+
+
+def build_lib(src: str, lib: str, force: bool = False) -> str:
+    """Compile ``src`` to shared library ``lib`` if missing/stale; returns
+    the .so path. Raises on failure."""
+    if (
+        not force
+        and os.path.exists(lib)
+        and os.path.getmtime(lib) >= os.path.getmtime(src)
+    ):
+        return lib
+    tmp = f"{lib}.{os.getpid()}.tmp"  # unique per process: concurrent first
+    # runs (two peers on one machine) must not clobber each other's output
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, lib)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    return lib
 
 
 def ensure_built(force: bool = False) -> str:
-    """Compile if missing/stale; returns the .so path. Raises on failure."""
-    if (
-        not force
-        and os.path.exists(LIB)
-        and os.path.getmtime(LIB) >= os.path.getmtime(SRC)
-    ):
-        return LIB
-    tmp = LIB + ".tmp"
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", SRC, "-o", tmp]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
-    os.replace(tmp, LIB)
-    return LIB
+    """The UDP poller library (back-compat entry point)."""
+    return build_lib(SRC, LIB, force)
+
+
+def ensure_core_built(force: bool = False) -> str:
+    """The session data-plane core library."""
+    return build_lib(CORE_SRC, CORE_LIB, force)
 
 
 if __name__ == "__main__":
     print(ensure_built(force=True))
+    print(ensure_core_built(force=True))

@@ -1,0 +1,388 @@
+"""ctypes bindings for the native session data-plane (+ Python fallback).
+
+The session layer constructs its per-player input queues and its
+misprediction tracker through :func:`make_queue_set` / :func:`make_tracker`.
+When the C++ core (``session_core.cpp``) builds, those return thin ctypes
+wrappers whose surface is identical to the pure-Python
+:class:`~bevy_ggrs_tpu.session.input_queue.InputQueue` / tracker logic they
+replace — sessions are agnostic. Set ``BEVY_GGRS_TPU_NATIVE=0`` to force the
+Python path (parity tests run both).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+NULL_FRAME = -1  # matches bevy_ggrs_tpu.session.common (not imported here:
+# the session package imports this module at load time)
+
+_INT32_MAX = 2**31 - 1
+
+
+def _invalid_request(msg: str) -> Exception:
+    from bevy_ggrs_tpu.session.common import InvalidRequest
+
+    return InvalidRequest(msg)
+
+_lib = None
+_load_failed = False
+
+
+def _load():
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    if os.environ.get("BEVY_GGRS_TPU_NATIVE", "1").lower() in ("0", "false"):
+        return None
+    try:
+        from bevy_ggrs_tpu.native.build import ensure_core_built
+
+        lib = ctypes.CDLL(ensure_core_built())
+    except Exception:
+        _load_failed = True  # don't re-attempt the compile per constructor
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.ggrs_qs_new.argtypes = [ctypes.c_int, ctypes.c_int, u8p, i32p]
+    lib.ggrs_qs_new.restype = ctypes.c_void_p
+    lib.ggrs_qs_free.argtypes = [ctypes.c_void_p]
+    lib.ggrs_qs_last_confirmed.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ggrs_qs_last_confirmed.restype = ctypes.c_int32
+    lib.ggrs_qs_delay.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ggrs_qs_delay.restype = ctypes.c_int
+    lib.ggrs_qs_add_input.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int32, u8p]
+    lib.ggrs_qs_add_input.restype = ctypes.c_int32
+    lib.ggrs_qs_add_local.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int32, u8p]
+    lib.ggrs_qs_add_local.restype = ctypes.c_int32
+    lib.ggrs_qs_confirmed.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int32, u8p]
+    lib.ggrs_qs_confirmed.restype = ctypes.c_int
+    lib.ggrs_qs_input.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int32, u8p]
+    lib.ggrs_qs_input.restype = ctypes.c_int
+    lib.ggrs_qs_discard_before.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.ggrs_qs_min_confirmed.argtypes = [ctypes.c_void_p, u8p]
+    lib.ggrs_qs_min_confirmed.restype = ctypes.c_int32
+    lib.ggrs_qs_gather.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, i32p, u8p, i32p]
+    lib.ggrs_qs_gather.restype = ctypes.c_int
+    lib.ggrs_rt_new.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.ggrs_rt_new.restype = ctypes.c_void_p
+    lib.ggrs_rt_free.argtypes = [ctypes.c_void_p]
+    lib.ggrs_rt_record_used.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, u8p, i32p]
+    lib.ggrs_rt_note_confirmed.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int32, u8p]
+    lib.ggrs_rt_first_incorrect.argtypes = [ctypes.c_void_p]
+    lib.ggrs_rt_first_incorrect.restype = ctypes.c_int32
+    lib.ggrs_rt_clear_first_incorrect.argtypes = [ctypes.c_void_p]
+    lib.ggrs_rt_get_used.argtypes = [ctypes.c_void_p, ctypes.c_int32, u8p, i32p]
+    lib.ggrs_rt_get_used.restype = ctypes.c_int
+    lib.ggrs_rt_discard_before.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _u8p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _i32p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+# ---------------------------------------------------------------------------
+# Native queue set
+# ---------------------------------------------------------------------------
+
+
+class _NativeQueueView:
+    """InputQueue-compatible view over one player's native queue."""
+
+    __slots__ = ("_qs", "_h")
+
+    def __init__(self, qs: "NativeQueueSet", handle: int):
+        self._qs = qs
+        self._h = handle
+
+    @property
+    def delay(self) -> int:
+        return self._qs._delays[self._h]
+
+    @property
+    def last_confirmed_frame(self) -> int:
+        return int(_lib.ggrs_qs_last_confirmed(self._qs._ptr, self._h))
+
+    def add_input(self, frame: int, bits) -> Optional[int]:
+        got = int(
+            _lib.ggrs_qs_add_input(
+                self._qs._ptr, self._h, int(frame), _u8p(self._qs._in(bits))
+            )
+        )
+        if got == -2:
+            raise _invalid_request(
+                f"non-contiguous input: got frame {frame}, expected "
+                f"{self.last_confirmed_frame + 1}"
+            )
+        return None if got == -1 else got
+
+    def add_local_input(self, frame: int, bits) -> int:
+        return int(
+            _lib.ggrs_qs_add_local(
+                self._qs._ptr, self._h, int(frame), _u8p(self._qs._in(bits))
+            )
+        )
+
+    def confirmed(self, frame: int) -> Optional[np.ndarray]:
+        flat = self._qs._out_flat(1)
+        if _lib.ggrs_qs_confirmed(self._qs._ptr, self._h, int(frame), _u8p(flat)):
+            return self._qs._decode_one(flat)
+        return None
+
+    def input(self, frame: int) -> Tuple[np.ndarray, bool]:
+        flat = self._qs._out_flat(1)
+        got = int(_lib.ggrs_qs_input(self._qs._ptr, self._h, int(frame), _u8p(flat)))
+        if got < 0:
+            raise _invalid_request(f"input for frame {frame} was discarded")
+        return self._qs._decode_one(flat), bool(got)
+
+    def discard_before(self, frame: int) -> None:
+        # Per-queue discard is only used via the set-level call in sessions;
+        # native discards the whole set at once (same horizon for all).
+        self._qs.discard_before(frame)
+
+
+class NativeQueueSet:
+    def __init__(self, zero: np.ndarray, delays: Sequence[int]):
+        # NB: np.ascontiguousarray would promote 0-d inputs to 1-d and
+        # corrupt the spec shape; reshape(-1) for the byte view instead.
+        zero = np.asarray(zero)
+        self._dtype = zero.dtype
+        self._shape = zero.shape
+        self._nbytes = zero.nbytes
+        self._num_players = len(delays)
+        self._delays = [int(d) for d in delays]
+        d = np.asarray(self._delays, dtype=np.int32)
+        self._ptr = _lib.ggrs_qs_new(
+            self._num_players,
+            self._nbytes,
+            _u8p(zero.reshape(-1).view(np.uint8)),
+            _i32p(d),
+        )
+        self.queues: List[_NativeQueueView] = [
+            _NativeQueueView(self, h) for h in range(self._num_players)
+        ]
+
+    def _in(self, bits) -> np.ndarray:
+        arr = np.asarray(bits, dtype=self._dtype).reshape(self._shape)
+        return np.ascontiguousarray(arr.reshape(-1)).view(np.uint8)
+
+    def _out_flat(self, n: int) -> np.ndarray:
+        return np.empty(n * self._nbytes, dtype=np.uint8)
+
+    def _decode_one(self, flat: np.ndarray) -> np.ndarray:
+        return flat.view(self._dtype).reshape(self._shape)
+
+    def discard_before(self, frame: int) -> None:
+        _lib.ggrs_qs_discard_before(self._ptr, int(frame))
+
+    def min_confirmed(self, connected=None) -> int:
+        if connected is None:
+            mask = np.ones(self._num_players, dtype=np.uint8)
+        else:
+            mask = np.ascontiguousarray(np.asarray(connected, dtype=np.uint8))
+        return int(_lib.ggrs_qs_min_confirmed(self._ptr, _u8p(mask)))
+
+    def gather(
+        self, frame: int, disc_frames: Optional[Sequence[int]] = None
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Fused per-frame input assembly: ``(bits[P, *shape], status[P])``."""
+        P = self._num_players
+        flat = self._out_flat(P)
+        status = np.empty((P,), dtype=np.int32)
+        if disc_frames is None:
+            disc = np.full((P,), _INT32_MAX, dtype=np.int32)
+        else:
+            disc = np.ascontiguousarray(np.asarray(disc_frames, dtype=np.int32))
+        rc = _lib.ggrs_qs_gather(
+            self._ptr, int(frame), _i32p(disc), _u8p(flat), _i32p(status)
+        )
+        if rc != 0:
+            raise _invalid_request(f"input for frame {frame} was discarded")
+        bits = flat.view(self._dtype).reshape((P,) + self._shape)
+        return bits, status
+
+    def __del__(self):
+        try:
+            if self._ptr:
+                _lib.ggrs_qs_free(self._ptr)
+                self._ptr = None
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Python fallback queue set
+# ---------------------------------------------------------------------------
+
+
+class PyQueueSet:
+    def __init__(self, zero: np.ndarray, delays: Sequence[int]):
+        from bevy_ggrs_tpu.session.input_queue import InputQueue
+
+        zero = np.asarray(zero)
+        self._zero = zero
+        self._num_players = len(delays)
+        self.queues = [InputQueue(zero, int(d)) for d in delays]
+
+    def discard_before(self, frame: int) -> None:
+        for q in self.queues:
+            q.discard_before(frame)
+
+    def min_confirmed(self, connected=None) -> int:
+        frames = [
+            q.last_confirmed_frame
+            for h, q in enumerate(self.queues)
+            if connected is None or connected[h]
+        ]
+        return min(frames) if frames else NULL_FRAME
+
+    def gather(
+        self, frame: int, disc_frames: Optional[Sequence[int]] = None
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        from bevy_ggrs_tpu.schedule import CONFIRMED, DISCONNECTED, PREDICTED
+
+        P = self._num_players
+        bits = np.empty((P,) + self._zero.shape, self._zero.dtype)
+        status = np.empty((P,), np.int32)
+        for h, q in enumerate(self.queues):
+            b, is_confirmed = q.input(frame)
+            bits[h] = b
+            if disc_frames is not None and frame >= disc_frames[h]:
+                status[h] = DISCONNECTED
+            else:
+                status[h] = CONFIRMED if is_confirmed else PREDICTED
+        return bits, status
+
+
+# ---------------------------------------------------------------------------
+# Trackers
+# ---------------------------------------------------------------------------
+
+
+class NativeTracker:
+    def __init__(self, num_players: int, zero: np.ndarray):
+        zero = np.asarray(zero)
+        self._P = int(num_players)
+        self._dtype = zero.dtype
+        self._shape = zero.shape
+        self._nbytes = zero.nbytes
+        self._ptr = _lib.ggrs_rt_new(self._P, self._nbytes)
+
+    def _in_one(self, bits) -> np.ndarray:
+        arr = np.asarray(bits, dtype=self._dtype).reshape(self._shape)
+        return np.ascontiguousarray(arr.reshape(-1)).view(np.uint8)
+
+    def record_used(self, frame: int, bits: np.ndarray, status: np.ndarray) -> None:
+        b = np.asarray(bits, dtype=self._dtype).reshape((self._P,) + self._shape)
+        s = np.ascontiguousarray(np.asarray(status, dtype=np.int32))
+        _lib.ggrs_rt_record_used(
+            self._ptr, int(frame),
+            _u8p(np.ascontiguousarray(b.reshape(-1)).view(np.uint8)), _i32p(s)
+        )
+
+    def note_confirmed(self, handle: int, frame: int, bits) -> None:
+        _lib.ggrs_rt_note_confirmed(
+            self._ptr, int(handle), int(frame), _u8p(self._in_one(bits))
+        )
+
+    @property
+    def first_incorrect(self) -> int:
+        return int(_lib.ggrs_rt_first_incorrect(self._ptr))
+
+    def clear_first_incorrect(self) -> None:
+        _lib.ggrs_rt_clear_first_incorrect(self._ptr)
+
+    def get_used(self, frame: int):
+        flat = np.empty(self._P * self._nbytes, dtype=np.uint8)
+        status = np.empty((self._P,), dtype=np.int32)
+        got = _lib.ggrs_rt_get_used(self._ptr, int(frame), _u8p(flat), _i32p(status))
+        if not got:
+            return None
+        return flat.view(self._dtype).reshape((self._P,) + self._shape), status
+
+    def discard_before(self, frame: int) -> None:
+        _lib.ggrs_rt_discard_before(self._ptr, int(frame))
+
+    def __del__(self):
+        try:
+            if self._ptr:
+                _lib.ggrs_rt_free(self._ptr)
+                self._ptr = None
+        except Exception:
+            pass
+
+
+class PyTracker:
+    def __init__(self, num_players: int, zero: np.ndarray):
+        from bevy_ggrs_tpu.schedule import CONFIRMED
+
+        self._P = int(num_players)
+        self._confirmed = CONFIRMED
+        self._used = {}
+        self._first_incorrect = NULL_FRAME
+
+    def record_used(self, frame: int, bits: np.ndarray, status: np.ndarray) -> None:
+        self._used[int(frame)] = (np.array(bits, copy=True), np.array(status, copy=True))
+
+    def note_confirmed(self, handle: int, frame: int, bits) -> None:
+        used = self._used.get(int(frame))
+        if used is None:
+            return
+        used_bits, used_status = used
+        if used_status[handle] != self._confirmed and not np.array_equal(
+            used_bits[handle], np.asarray(bits, dtype=used_bits.dtype)
+        ):
+            if self._first_incorrect == NULL_FRAME or frame < self._first_incorrect:
+                self._first_incorrect = int(frame)
+
+    @property
+    def first_incorrect(self) -> int:
+        return self._first_incorrect
+
+    def clear_first_incorrect(self) -> None:
+        self._first_incorrect = NULL_FRAME
+
+    def get_used(self, frame: int):
+        return self._used.get(int(frame))
+
+    def discard_before(self, frame: int) -> None:
+        for f in [f for f in self._used if f < frame]:
+            del self._used[f]
+
+
+# ---------------------------------------------------------------------------
+# Factories
+# ---------------------------------------------------------------------------
+
+
+def make_queue_set(zero: np.ndarray, delays: Sequence[int]):
+    if available():
+        return NativeQueueSet(np.asarray(zero), delays)
+    return PyQueueSet(np.asarray(zero), delays)
+
+
+def make_tracker(num_players: int, zero: np.ndarray):
+    if available():
+        return NativeTracker(num_players, np.asarray(zero))
+    return PyTracker(num_players, np.asarray(zero))

@@ -1,0 +1,258 @@
+// Native session data-plane: input queues, prediction, misprediction
+// tracking.
+//
+// The reference delegates its whole session protocol to the external `ggrs`
+// Rust crate (Cargo.toml:24 — native code, not scripting). This library is
+// the analog for the latency-critical per-frame data plane of our Python
+// session layer (`bevy_ggrs_tpu/session/`): per-player confirmed-input
+// history with input delay and repeat-last-input prediction
+// (input_queue.py semantics), fused input gathering across players for an
+// AdvanceFrame request, and the used-record / first-incorrect-frame tracker
+// that turns late-arriving confirmed inputs into rollback decisions
+// (p2p.py `_note_confirmed`). Python keeps orchestration (timers, events,
+// socket pump); every per-frame/per-packet state mutation lands here.
+//
+// C ABI only (ctypes binding in native/core.py — no pybind11). All frame
+// numbers are int32; NULL_FRAME == -1 matches session/common.py.
+
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <utility>
+#include <vector>
+
+namespace {
+
+constexpr int32_t NULL_FRAME = -1;
+
+// Status codes must match bevy_ggrs_tpu/schedule.py.
+constexpr int32_t STATUS_CONFIRMED = 0;
+constexpr int32_t STATUS_PREDICTED = 1;
+constexpr int32_t STATUS_DISCONNECTED = 2;
+
+struct Queue {
+  int input_bytes = 0;
+  int delay = 0;
+  std::vector<uint8_t> zero;
+  std::vector<uint8_t> last_input;  // prediction source; survives discard
+  int32_t last_confirmed = NULL_FRAME;
+  int32_t base = 0;  // frame of inputs.front() when non-empty
+  std::deque<std::vector<uint8_t>> inputs;
+
+  // Returns recorded frame, -1 if stale (duplicate/old), -2 on gap.
+  int32_t add_input(int32_t frame, const uint8_t* bits) {
+    if (frame <= last_confirmed) return -1;
+    if (frame != last_confirmed + 1) return -2;
+    if (inputs.empty()) base = frame;
+    inputs.emplace_back(bits, bits + input_bytes);
+    last_confirmed = frame;
+    last_input.assign(bits, bits + input_bytes);
+    return frame;
+  }
+
+  int32_t add_local(int32_t frame, const uint8_t* bits) {
+    int32_t target = frame + delay;
+    while (last_confirmed < target - 1)
+      add_input(last_confirmed + 1, zero.data());
+    add_input(target, bits);
+    return target;
+  }
+
+  // 1 if a confirmed input for `frame` exists (copied to out), else 0.
+  int confirmed(int32_t frame, uint8_t* out) const {
+    if (inputs.empty() || frame < base || frame > last_confirmed) return 0;
+    if (out)
+      std::memcpy(out, inputs[size_t(frame - base)].data(), input_bytes);
+    return 1;
+  }
+
+  // 1 = confirmed, 0 = predicted, -1 = frame was discarded (caller bug).
+  int input(int32_t frame, uint8_t* out) const {
+    if (frame <= last_confirmed) {
+      if (inputs.empty() || frame < base) return -1;
+      std::memcpy(out, inputs[size_t(frame - base)].data(), input_bytes);
+      return 1;
+    }
+    const std::vector<uint8_t>& src =
+        (last_confirmed == NULL_FRAME) ? zero : last_input;
+    std::memcpy(out, src.data(), input_bytes);
+    return 0;
+  }
+
+  void discard_before(int32_t frame) {
+    while (!inputs.empty() && base < frame) {
+      inputs.pop_front();
+      ++base;
+    }
+  }
+};
+
+struct QueueSet {
+  int num_players = 0;
+  int input_bytes = 0;
+  std::vector<Queue> queues;
+};
+
+struct Tracker {
+  int num_players = 0;
+  int input_bytes = 0;
+  int32_t first_incorrect = NULL_FRAME;
+  // frame -> (bits[P*input_bytes], status[P]); the record handed-out
+  // predictions are checked against when real inputs arrive.
+  std::map<int32_t, std::pair<std::vector<uint8_t>, std::vector<int32_t>>>
+      used;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- QueueSet
+
+void* ggrs_qs_new(int num_players, int input_bytes, const uint8_t* zero,
+                  const int32_t* delays) {
+  auto* qs = new QueueSet();
+  qs->num_players = num_players;
+  qs->input_bytes = input_bytes;
+  qs->queues.resize(size_t(num_players));
+  for (int h = 0; h < num_players; ++h) {
+    Queue& q = qs->queues[size_t(h)];
+    q.input_bytes = input_bytes;
+    q.delay = delays ? int(delays[h]) : 0;
+    q.zero.assign(zero, zero + input_bytes);
+    q.last_input = q.zero;
+  }
+  return qs;
+}
+
+void ggrs_qs_free(void* p) { delete static_cast<QueueSet*>(p); }
+
+int32_t ggrs_qs_last_confirmed(void* p, int handle) {
+  return static_cast<QueueSet*>(p)->queues[size_t(handle)].last_confirmed;
+}
+
+int ggrs_qs_delay(void* p, int handle) {
+  return static_cast<QueueSet*>(p)->queues[size_t(handle)].delay;
+}
+
+int32_t ggrs_qs_add_input(void* p, int handle, int32_t frame,
+                          const uint8_t* bits) {
+  return static_cast<QueueSet*>(p)->queues[size_t(handle)].add_input(frame,
+                                                                     bits);
+}
+
+int32_t ggrs_qs_add_local(void* p, int handle, int32_t frame,
+                          const uint8_t* bits) {
+  return static_cast<QueueSet*>(p)->queues[size_t(handle)].add_local(frame,
+                                                                     bits);
+}
+
+int ggrs_qs_confirmed(void* p, int handle, int32_t frame, uint8_t* out) {
+  return static_cast<QueueSet*>(p)->queues[size_t(handle)].confirmed(frame,
+                                                                     out);
+}
+
+int ggrs_qs_input(void* p, int handle, int32_t frame, uint8_t* out) {
+  return static_cast<QueueSet*>(p)->queues[size_t(handle)].input(frame, out);
+}
+
+void ggrs_qs_discard_before(void* p, int32_t frame) {
+  for (Queue& q : static_cast<QueueSet*>(p)->queues) q.discard_before(frame);
+}
+
+// Highest frame confirmed for every connected player (connected[h] != 0);
+// NULL_FRAME when no player is connected. Mirrors P2PSession.confirmed_frame.
+int32_t ggrs_qs_min_confirmed(void* p, const uint8_t* connected) {
+  auto* qs = static_cast<QueueSet*>(p);
+  bool any = false;
+  int32_t m = INT32_MAX;
+  for (int h = 0; h < qs->num_players; ++h) {
+    if (connected && !connected[h]) continue;
+    any = true;
+    if (qs->queues[size_t(h)].last_confirmed < m)
+      m = qs->queues[size_t(h)].last_confirmed;
+  }
+  return any ? m : NULL_FRAME;
+}
+
+// Fused AdvanceFrame assembly: inputs + status for every player at `frame`.
+// disc_frames[h] is the frame the player disconnected at (INT32_MAX when
+// connected); status follows p2p.py `_advance_request`. Returns 0, or -1 if
+// any queue had already discarded `frame` (protocol violation).
+int ggrs_qs_gather(void* p, int32_t frame, const int32_t* disc_frames,
+                   uint8_t* out_bits, int32_t* out_status) {
+  auto* qs = static_cast<QueueSet*>(p);
+  for (int h = 0; h < qs->num_players; ++h) {
+    int got = qs->queues[size_t(h)].input(
+        frame, out_bits + size_t(h) * size_t(qs->input_bytes));
+    if (got < 0) return -1;
+    if (disc_frames && frame >= disc_frames[h])
+      out_status[h] = STATUS_DISCONNECTED;
+    else
+      out_status[h] = got ? STATUS_CONFIRMED : STATUS_PREDICTED;
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------- Tracker
+
+void* ggrs_rt_new(int num_players, int input_bytes) {
+  auto* t = new Tracker();
+  t->num_players = num_players;
+  t->input_bytes = input_bytes;
+  return t;
+}
+
+void ggrs_rt_free(void* p) { delete static_cast<Tracker*>(p); }
+
+void ggrs_rt_record_used(void* p, int32_t frame, const uint8_t* bits,
+                         const int32_t* status) {
+  auto* t = static_cast<Tracker*>(p);
+  size_t nb = size_t(t->num_players) * size_t(t->input_bytes);
+  t->used[frame] = {std::vector<uint8_t>(bits, bits + nb),
+                    std::vector<int32_t>(status, status + t->num_players)};
+}
+
+// A confirmed input for (handle, frame) arrived; if that frame was simulated
+// with different non-confirmed bits, mark it first-incorrect.
+void ggrs_rt_note_confirmed(void* p, int handle, int32_t frame,
+                            const uint8_t* bits) {
+  auto* t = static_cast<Tracker*>(p);
+  auto it = t->used.find(frame);
+  if (it == t->used.end()) return;
+  const auto& [used_bits, used_status] = it->second;
+  if (used_status[size_t(handle)] == STATUS_CONFIRMED) return;
+  const uint8_t* u =
+      used_bits.data() + size_t(handle) * size_t(t->input_bytes);
+  if (std::memcmp(u, bits, size_t(t->input_bytes)) != 0) {
+    if (t->first_incorrect == NULL_FRAME || frame < t->first_incorrect)
+      t->first_incorrect = frame;
+  }
+}
+
+int32_t ggrs_rt_first_incorrect(void* p) {
+  return static_cast<Tracker*>(p)->first_incorrect;
+}
+
+void ggrs_rt_clear_first_incorrect(void* p) {
+  static_cast<Tracker*>(p)->first_incorrect = NULL_FRAME;
+}
+
+int ggrs_rt_get_used(void* p, int32_t frame, uint8_t* out_bits,
+                     int32_t* out_status) {
+  auto* t = static_cast<Tracker*>(p);
+  auto it = t->used.find(frame);
+  if (it == t->used.end()) return 0;
+  std::memcpy(out_bits, it->second.first.data(), it->second.first.size());
+  std::memcpy(out_status, it->second.second.data(),
+              sizeof(int32_t) * size_t(t->num_players));
+  return 1;
+}
+
+void ggrs_rt_discard_before(void* p, int32_t frame) {
+  auto* t = static_cast<Tracker*>(p);
+  t->used.erase(t->used.begin(), t->used.lower_bound(frame));
+}
+
+}  // extern "C"

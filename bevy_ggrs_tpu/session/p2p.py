@@ -29,11 +29,11 @@ Spectator fan-out: host-side, every spectator address gets a stream of
 from __future__ import annotations
 
 import time as _time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from bevy_ggrs_tpu.schedule import CONFIRMED, DISCONNECTED, PREDICTED, InputSpec
+from bevy_ggrs_tpu.schedule import InputSpec
 from bevy_ggrs_tpu.session import protocol as proto
 from bevy_ggrs_tpu.session.common import (
     EventKind,
@@ -45,8 +45,8 @@ from bevy_ggrs_tpu.session.common import (
     SessionState,
     NULL_FRAME,
 )
+from bevy_ggrs_tpu.native.core import make_queue_set, make_tracker
 from bevy_ggrs_tpu.session.endpoint import PeerEndpoint, PeerState
-from bevy_ggrs_tpu.session.input_queue import InputQueue
 from bevy_ggrs_tpu.session.requests import AdvanceFrame, LoadGameState, SaveGameState
 
 CHECKSUM_SEND_INTERVAL = 16  # frames between checksum reports to peers
@@ -85,10 +85,16 @@ class P2PSession:
 
         zero = input_spec.zeros_np(1)[0]
         self._zero = zero
-        self._queues = [
-            InputQueue(zero, input_delay if h in local_players else 0)
-            for h in range(num_players)
-        ]
+        # Input history + misprediction tracking live in the native session
+        # core when it builds (bevy_ggrs_tpu/native/session_core.cpp) — the
+        # analog of the reference's session protocol being native (the Rust
+        # ggrs crate). Python fallback is semantically identical.
+        self._qset = make_queue_set(
+            zero,
+            [input_delay if h in local_players else 0 for h in range(num_players)],
+        )
+        self._queues = self._qset.queues
+        self._tracker = make_tracker(num_players, zero)
         self.local_handles = sorted(local_players)
         self._handle_addr: Dict[int, object] = dict(remote_players)
         self._disconnected: Dict[int, int] = {}  # handle -> frame of disconnect
@@ -108,10 +114,6 @@ class P2PSession:
 
         self.current_frame = 0
         self._pending_local: Dict[int, np.ndarray] = {}
-        # Inputs actually used per simulated frame: frame -> (bits[P,…],
-        # status[P]); the record predictions are checked against.
-        self._used: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
-        self._first_incorrect = NULL_FRAME
         self._events: List[SessionEvent] = []
         self._local_checksums: Dict[int, int] = {}
         self._last_checksum_sent = NULL_FRAME
@@ -139,12 +141,9 @@ class P2PSession:
     def confirmed_frame(self) -> int:
         """Highest frame for which every connected player's input is
         confirmed (local inputs confirm at add time, after input delay)."""
-        frames = [
-            q.last_confirmed_frame
-            for h, q in enumerate(self._queues)
-            if h not in self._disconnected
-        ]
-        return min(frames) if frames else NULL_FRAME
+        return self._qset.min_confirmed(
+            [h not in self._disconnected for h in range(self.num_players)]
+        )
 
     def frames_ahead(self) -> int:
         """How many frames we should yield to let slower peers catch up
@@ -265,15 +264,7 @@ class P2PSession:
         """A confirmed input arrived; if we already simulated ``frame`` with
         different bits (a prediction, or a disconnect-freeze later corrected
         by a surviving peer's relay), schedule a rollback to it."""
-        used = self._used.get(frame)
-        if used is None:
-            return
-        used_bits, used_status = used
-        if used_status[handle] != CONFIRMED and not np.array_equal(
-            used_bits[handle], bits
-        ):
-            if self._first_incorrect == NULL_FRAME or frame < self._first_incorrect:
-                self._first_incorrect = frame
+        self._tracker.note_confirmed(handle, frame, bits)
 
     def _on_peer_disconnected(self, addr: object) -> None:
         """All handles at ``addr`` become disconnected: their inputs freeze
@@ -334,7 +325,8 @@ class P2PSession:
         re-reports it)."""
         if frame > self.confirmed_frame():
             return False
-        return self._first_incorrect == NULL_FRAME or frame < self._first_incorrect
+        fi = self._tracker.first_incorrect
+        return fi == NULL_FRAME or frame < fi
 
     def _maybe_send_checksums(self, now: float) -> None:
         target = (
@@ -425,13 +417,13 @@ class P2PSession:
         requests: List[object] = []
 
         # Rollback: a confirmed input contradicted a prediction.
-        if self._first_incorrect != NULL_FRAME:
-            rollback_to = self._first_incorrect
+        rollback_to = self._tracker.first_incorrect
+        if rollback_to != NULL_FRAME:
             requests.append(LoadGameState(rollback_to))
             for f in range(rollback_to, frame):
                 requests.append(SaveGameState(f))
                 requests.append(self._advance_request(f))
-            self._first_incorrect = NULL_FRAME
+            self._tracker.clear_first_incorrect()
 
         # The new frame.
         requests.append(SaveGameState(frame))
@@ -443,16 +435,11 @@ class P2PSession:
         return requests
 
     def _advance_request(self, frame: int) -> AdvanceFrame:
-        bits = np.empty((self.num_players,) + self._zero.shape, self._zero.dtype)
-        status = np.empty((self.num_players,), np.int32)
-        for h, q in enumerate(self._queues):
-            b, is_confirmed = q.input(frame)
-            bits[h] = b
-            if h in self._disconnected and frame >= self._disconnected[h]:
-                status[h] = DISCONNECTED
-            else:
-                status[h] = CONFIRMED if is_confirmed else PREDICTED
-        self._used[frame] = (bits.copy(), status.copy())
+        disc = [
+            self._disconnected.get(h, 2**31 - 1) for h in range(self.num_players)
+        ]
+        bits, status = self._qset.gather(frame, disc)
+        self._tracker.record_used(frame, bits, status)
         return AdvanceFrame(bits=bits, status=status)
 
     def _fanout_spectators(self) -> None:
@@ -500,7 +487,5 @@ class P2PSession:
             self.current_frame - self.max_prediction - 1,
             self._spectator_floor(),
         )
-        for q in self._queues:
-            q.discard_before(horizon)
-        for f in [f for f in self._used if f < horizon]:
-            del self._used[f]
+        self._qset.discard_before(horizon)
+        self._tracker.discard_before(horizon)

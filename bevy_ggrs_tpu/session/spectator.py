@@ -29,8 +29,8 @@ from bevy_ggrs_tpu.session.common import (
     SessionState,
     NULL_FRAME,
 )
+from bevy_ggrs_tpu.native.core import make_queue_set
 from bevy_ggrs_tpu.session.endpoint import PeerEndpoint, PeerState
-from bevy_ggrs_tpu.session.input_queue import InputQueue
 from bevy_ggrs_tpu.session.requests import AdvanceFrame
 
 
@@ -55,7 +55,8 @@ class SpectatorSession:
         self._clock = clock if clock is not None else _time.monotonic
 
         self._zero = input_spec.zeros_np(1)[0]
-        self._queues = [InputQueue(self._zero, 0) for _ in range(num_players)]
+        self._qset = make_queue_set(self._zero, [0] * num_players)
+        self._queues = self._qset.queues
         rng = np.random.RandomState(seed)
         self._endpoint = PeerEndpoint(host_addr, rng)
         self.current_frame = 0
@@ -126,7 +127,7 @@ class SpectatorSession:
     # ------------------------------------------------------------------
 
     def _confirmed_frame(self) -> int:
-        return min(q.last_confirmed_frame for q in self._queues)
+        return self._qset.min_confirmed()
 
     def advance_frame(self) -> List[AdvanceFrame]:
         """Only ``AdvanceFrame`` requests, only on confirmed data.
@@ -149,11 +150,9 @@ class SpectatorSession:
         requests = []
         for _ in range(n):
             frame = self.current_frame
-            bits = np.stack([q.input(frame)[0] for q in self._queues])
+            bits, _ = self._qset.gather(frame)
             status = np.full((self.num_players,), CONFIRMED, dtype=np.int32)
             requests.append(AdvanceFrame(bits=bits, status=status))
             self.current_frame = frame + 1
-        horizon = self.current_frame - 2
-        for q in self._queues:
-            q.discard_before(horizon)
+        self._qset.discard_before(self.current_frame - 2)
         return requests

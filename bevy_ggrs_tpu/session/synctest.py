@@ -23,7 +23,7 @@ from bevy_ggrs_tpu.session.common import (
     MismatchedChecksum,
     SessionState,
 )
-from bevy_ggrs_tpu.session.input_queue import InputQueue
+from bevy_ggrs_tpu.native.core import make_queue_set
 from bevy_ggrs_tpu.session.requests import AdvanceFrame, LoadGameState, SaveGameState
 
 
@@ -47,7 +47,8 @@ class SyncTestSession:
         self.max_prediction = int(max_prediction)
         self.current_frame = 0
         zero = input_spec.zeros_np(1)[0]
-        self._queues = [InputQueue(zero, input_delay) for _ in range(num_players)]
+        self._qset = make_queue_set(zero, [input_delay] * num_players)
+        self._queues = self._qset.queues
         self._pending: Dict[int, np.ndarray] = {}
         self._checksums: Dict[int, int] = {}
 
@@ -90,14 +91,15 @@ class SyncTestSession:
         self.current_frame = frame + 1
         # GC: inputs/checksums older than the deepest future rollback.
         horizon = self.current_frame - self.check_distance - 1
-        for q in self._queues:
-            q.discard_before(horizon)
+        self._qset.discard_before(horizon)
         for f in [f for f in self._checksums if f < horizon]:
             del self._checksums[f]
         return requests
 
     def _advance_request(self, frame: int) -> AdvanceFrame:
-        bits = np.stack([q.input(frame)[0] for q in self._queues])
+        bits, _ = self._qset.gather(frame)
+        # All players are local and fed each frame, so every input is
+        # confirmed by construction.
         status = np.full((self.num_players,), CONFIRMED, dtype=np.int32)
         return AdvanceFrame(bits=bits, status=status)
 

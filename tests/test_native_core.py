@@ -1,0 +1,172 @@
+"""Parity tests: native C++ session core vs the pure-Python data plane.
+
+The native core (``native/session_core.cpp``) must be semantically identical
+to the Python ``InputQueue`` / tracker logic it replaces — same outputs, same
+exceptions, same request streams. These tests drive both through randomized
+op sequences and a full SyncTest session and assert bit-for-bit agreement.
+"""
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu.native import core as ncore
+from bevy_ggrs_tpu.session.common import InvalidRequest
+from bevy_ggrs_tpu.session.input_queue import InputQueue
+
+native = pytest.mark.skipif(
+    not ncore.available(), reason="native session core did not build"
+)
+
+
+@native
+def test_queue_basic_parity():
+    for shape, dtype in [((), np.uint8), ((3,), np.int16), ((2, 2), np.uint32)]:
+        zero = np.zeros(shape, dtype)
+        nq = ncore.NativeQueueSet(zero, [2]).queues[0]
+        pq = InputQueue(zero, 2)
+        rng = np.random.RandomState(0)
+        for frame in range(30):
+            bits = rng.randint(0, 100, size=shape).astype(dtype)
+            assert nq.add_local_input(frame, bits) == pq.add_local_input(
+                frame, bits
+            )
+            for f in range(frame + 4):
+                nb, nc = nq.input(f)
+                pb, pc = pq.input(f)
+                assert nc == pc and np.array_equal(nb, pb), (shape, frame, f)
+                got_n, got_p = nq.confirmed(f), pq.confirmed(f)
+                assert (got_n is None) == (got_p is None)
+                if got_n is not None:
+                    assert np.array_equal(got_n, got_p)
+            assert nq.last_confirmed_frame == pq.last_confirmed_frame
+
+
+@native
+def test_queue_stale_and_gap_parity():
+    zero = np.zeros((), np.uint8)
+    nq = ncore.NativeQueueSet(zero, [0]).queues[0]
+    pq = InputQueue(zero, 0)
+    assert nq.add_input(0, 7) == pq.add_input(0, 7) == 0
+    # Stale (duplicate) frames are ignored in both.
+    assert nq.add_input(0, 9) is None and pq.add_input(0, 9) is None
+    # Gaps raise in both.
+    with pytest.raises(InvalidRequest):
+        nq.add_input(5, 1)
+    with pytest.raises(InvalidRequest):
+        pq.add_input(5, 1)
+
+
+@native
+def test_queue_discard_parity():
+    zero = np.zeros((), np.uint8)
+    nqs = ncore.NativeQueueSet(zero, [0])
+    nq = nqs.queues[0]
+    pq = InputQueue(zero, 0)
+    for f in range(10):
+        nq.add_input(f, f + 1)
+        pq.add_input(f, f + 1)
+    nqs.discard_before(6)
+    pq.discard_before(6)
+    for f in range(6, 10):
+        assert np.array_equal(nq.confirmed(f), pq.confirmed(f))
+    assert nq.confirmed(5) is None and pq.confirmed(5) is None
+    with pytest.raises(InvalidRequest):
+        nq.input(3)
+    with pytest.raises(InvalidRequest):
+        pq.input(3)
+    # Prediction source survives the discard in both.
+    nb, nc = nq.input(99)
+    pb, pc = pq.input(99)
+    assert not nc and not pc and np.array_equal(nb, pb)
+
+
+@native
+def test_gather_matches_python_loop():
+    zero = np.zeros((2,), np.uint8)
+    delays = [1, 0, 0]
+    nqs = ncore.NativeQueueSet(zero, delays)
+    pqs = ncore.PyQueueSet(zero, delays)
+    rng = np.random.RandomState(1)
+    disc = [2**31 - 1, 4, 2**31 - 1]  # player 1 disconnects at frame 4
+    for frame in range(8):
+        for h in range(3):
+            bits = rng.randint(0, 255, size=(2,)).astype(np.uint8)
+            if h == 1 and frame >= 4:
+                continue  # disconnected: no more inputs
+            nqs.queues[h].add_local_input(frame, bits)
+            pqs.queues[h].add_local_input(frame, bits)
+        nb, ns = nqs.gather(frame, disc)
+        pb, ps = pqs.gather(frame, disc)
+        assert np.array_equal(nb, pb) and np.array_equal(ns, ps), frame
+    assert nqs.min_confirmed([1, 0, 1]) == pqs.min_confirmed([1, 0, 1])
+    assert nqs.min_confirmed() == pqs.min_confirmed()
+
+
+@native
+def test_tracker_parity_randomized():
+    zero = np.zeros((), np.uint8)
+    nt = ncore.NativeTracker(2, zero)
+    pt = ncore.PyTracker(2, zero)
+    rng = np.random.RandomState(2)
+    for step in range(200):
+        op = rng.randint(0, 4)
+        frame = int(rng.randint(0, 20))
+        if op == 0:
+            bits = rng.randint(0, 4, size=(2,)).astype(np.uint8)
+            status = rng.randint(0, 2, size=(2,)).astype(np.int32)
+            nt.record_used(frame, bits, status)
+            pt.record_used(frame, bits, status)
+        elif op == 1:
+            h = int(rng.randint(0, 2))
+            b = np.uint8(rng.randint(0, 4))
+            nt.note_confirmed(h, frame, b)
+            pt.note_confirmed(h, frame, b)
+        elif op == 2:
+            nt.clear_first_incorrect()
+            pt.clear_first_incorrect()
+        else:
+            nt.discard_before(frame)
+            pt.discard_before(frame)
+        assert nt.first_incorrect == pt.first_incorrect, step
+        got_n, got_p = nt.get_used(frame), pt.get_used(frame)
+        assert (got_n is None) == (got_p is None)
+        if got_n is not None:
+            assert np.array_equal(got_n[0], got_p[0])
+            assert np.array_equal(got_n[1], got_p[1])
+
+
+@native
+def test_synctest_request_stream_parity(monkeypatch):
+    """A full SyncTest session produces identical request streams through
+    the native and Python data planes."""
+    from bevy_ggrs_tpu.session.requests import (
+        AdvanceFrame,
+        LoadGameState,
+        SaveGameState,
+    )
+    from bevy_ggrs_tpu.session.synctest import SyncTestSession
+
+    def run(force_py: bool):
+        if force_py:
+            monkeypatch.setattr(ncore, "available", lambda: False)
+        else:
+            monkeypatch.undo()
+        sess = SyncTestSession(2, check_distance=3, max_prediction=8,
+                               input_delay=1)
+        rng = np.random.RandomState(3)
+        stream = []
+        for frame in range(12):
+            for h in range(2):
+                sess.add_local_input(h, np.uint8(rng.randint(0, 16)))
+            for req in sess.advance_frame():
+                if isinstance(req, SaveGameState):
+                    stream.append(("save", req.frame))
+                elif isinstance(req, LoadGameState):
+                    stream.append(("load", req.frame))
+                elif isinstance(req, AdvanceFrame):
+                    stream.append(
+                        ("adv", req.bits.tobytes(), req.status.tobytes())
+                    )
+        return stream
+
+    assert run(force_py=False) == run(force_py=True)
