@@ -98,12 +98,37 @@ def flock_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
     work that a sharded variant splits by rows over the ``entity`` mesh axis
     (see ``bevy_ggrs_tpu.parallel.entity_sharding``).
     """
+    return _flock_step(state, inputs, _pairwise_forces)
+
+
+def flock_system_pallas(state: WorldState, inputs: PlayerInputs) -> WorldState:
+    """`flock_system` with the pairwise interaction tiled through VMEM by the
+    Pallas kernel (:mod:`bevy_ggrs_tpu.ops.pairwise`) instead of XLA's dense
+    [N, N] broadcast. allclose to — but not bitwise-equal with — the XLA
+    path; pick one per session (float caveat, reference
+    ``examples/README.md:13-18``)."""
+    from bevy_ggrs_tpu.ops.pairwise import pairwise_force_rows_pallas
+
+    def forces(pos, vel, active):
+        return pairwise_force_rows_pallas(
+            pos, vel, pos, vel, active, active,
+            neighbor_radius=float(NEIGHBOR_RADIUS),
+            separation_radius=float(SEPARATION_RADIUS),
+            w_separation=float(W_SEPARATION),
+            w_alignment=float(W_ALIGNMENT),
+            w_cohesion=float(W_COHESION),
+        )
+
+    return _flock_step(state, inputs, forces)
+
+
+def _flock_step(state: WorldState, inputs: PlayerInputs, pairwise_fn) -> WorldState:
     pos = state.components["position"]  # [N, 2]
     vel = state.components["velocity"]
     leader = state.components["leader_handle"]
     active = (state.alive & state.present["position"]).astype(jnp.float32)  # [N]
 
-    force = _pairwise_forces(pos, vel, active)
+    force = pairwise_fn(pos, vel, active)
 
     # Leader steering (player inputs), box_game-style exclusive keys.
     num_players = inputs.num_players
@@ -209,5 +234,6 @@ def increase_frame_system(state: WorldState, inputs: PlayerInputs) -> WorldState
     )
 
 
-def make_schedule() -> Schedule:
-    return Schedule([flock_system, increase_frame_system])
+def make_schedule(use_pallas: bool = False) -> Schedule:
+    step = flock_system_pallas if use_pallas else flock_system
+    return Schedule([step, increase_frame_system])

@@ -22,6 +22,12 @@ NULL_FRAME = -1  # matches bevy_ggrs_tpu.session.common (not imported here:
 
 _INT32_MAX = 2**31 - 1
 
+# Disconnect-frame sentinel meaning "this player never disconnected". The
+# value is a three-way contract: session_core.cpp compares
+# `frame >= disc_frames[h]` against INT32_MAX, make_tracker/gather default-fill
+# with it, and the p2p session passes it for connected players.
+NEVER_DISCONNECTED = _INT32_MAX
+
 
 def _invalid_request(msg: str) -> Exception:
     from bevy_ggrs_tpu.session.common import InvalidRequest
@@ -117,7 +123,7 @@ class _NativeQueueView:
 
     @property
     def delay(self) -> int:
-        return self._qs._delays[self._h]
+        return int(_lib.ggrs_qs_delay(self._qs._ptr, self._h))
 
     @property
     def last_confirmed_frame(self) -> int:

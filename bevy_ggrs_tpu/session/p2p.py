@@ -45,7 +45,11 @@ from bevy_ggrs_tpu.session.common import (
     SessionState,
     NULL_FRAME,
 )
-from bevy_ggrs_tpu.native.core import make_queue_set, make_tracker
+from bevy_ggrs_tpu.native.core import (
+    NEVER_DISCONNECTED,
+    make_queue_set,
+    make_tracker,
+)
 from bevy_ggrs_tpu.session.endpoint import PeerEndpoint, PeerState
 from bevy_ggrs_tpu.session.requests import AdvanceFrame, LoadGameState, SaveGameState
 
@@ -436,7 +440,8 @@ class P2PSession:
 
     def _advance_request(self, frame: int) -> AdvanceFrame:
         disc = [
-            self._disconnected.get(h, 2**31 - 1) for h in range(self.num_players)
+            self._disconnected.get(h, NEVER_DISCONNECTED)
+            for h in range(self.num_players)
         ]
         bits, status = self._qset.gather(frame, disc)
         self._tracker.record_used(frame, bits, status)

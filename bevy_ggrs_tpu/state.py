@@ -321,7 +321,13 @@ def checksum(state: WorldState) -> jnp.ndarray:
         h = _mix_words(h, words)
     h = _fmix(h)
     total = jnp.sum(jnp.where(state.alive, h, jnp.uint32(0)), dtype=jnp.uint32)
-    # Resources: order-sensitive stream, keyed by sorted name for stability.
+    return total + _resources_checksum(state)
+
+
+def _resources_checksum(state: WorldState) -> jnp.ndarray:
+    """Order-sensitive resource hash stream, keyed by sorted name for
+    stability; shared by the XLA and Pallas checksum paths."""
+    total = jnp.uint32(0)
     for name in sorted(state.resources):
         leaves = jax.tree_util.tree_leaves(state.resources[name])
         # Seed with the full name so same-length-named resources can't swap
@@ -335,6 +341,22 @@ def checksum(state: WorldState) -> jnp.ndarray:
             rh = _mix_words(rh, words)
         total = total + _fmix(rh)[0]
     return total
+
+
+# Pluggable checksum implementation for ring_save. The Pallas kernel
+# (bevy_ggrs_tpu.ops.checksum, bit-identical) installs itself here via
+# set_checksum_impl; None means the XLA path above. Jitted callers bind the
+# impl at trace time.
+_checksum_impl: list = [None]
+
+
+def set_checksum_impl(fn: Optional[Callable[[WorldState], jnp.ndarray]]) -> None:
+    _checksum_impl[0] = fn
+
+
+def active_checksum(state: WorldState) -> jnp.ndarray:
+    fn = _checksum_impl[0]
+    return fn(state) if fn is not None else checksum(state)
 
 
 # ---------------------------------------------------------------------------
@@ -385,7 +407,7 @@ def ring_save(
     """
     frame = jnp.asarray(frame, dtype=jnp.int32)
     slot = jnp.remainder(frame, ring.depth)
-    cs = checksum(state)
+    cs = active_checksum(state)
     new_states = jax.tree_util.tree_map(
         lambda r, s: jax.lax.dynamic_update_index_in_dim(r, s, slot, 0),
         ring.states,
