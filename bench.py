@@ -1,14 +1,19 @@
-"""Headline benchmark: box_game speculative rollback rollout.
+"""Headline benchmark + BASELINE.md config matrix.
 
-Target (BASELINE.md): resimulate 8 rollback frames × 256 speculative input
-branches for box_game inside one 60 Hz render frame (<16 ms) on a single TPU
-chip. The reference executes the same recovery serially on host CPU — up to
-``max_prediction`` × (restore + full schedule run) per render frame
+Headline (BASELINE.md target): resimulate 8 rollback frames × 256 speculative
+input branches for box_game inside one 60 Hz render frame (<16 ms) on a single
+TPU chip. The reference executes the same recovery serially on host CPU — up
+to ``max_prediction`` × (restore + full schedule run) per render frame
 (`/root/reference/src/ggrs_stage.rs:259-269`).
 
-Prints ONE JSON line:
+Default run prints ONE JSON line on stdout:
 ``{"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}``
-where ``vs_baseline`` > 1 means faster than the 16 ms budget.
+where ``vs_baseline`` > 1 means faster than the 16 ms one-render-frame budget.
+
+``python bench.py --all`` additionally measures every BASELINE.md config
+(1: parity 4f×1b, 2: 8f×64b, 3: 4p 8f×256b, 4: 1k boids 8f×128b,
+5: 8p 12f×1024b Monte Carlo) and writes the matrix to ``BENCH_DETAIL.json``;
+per-config lines go to stderr so stdout stays a single machine-readable line.
 """
 
 from __future__ import annotations
@@ -21,10 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-FRAMES = 8
-BRANCHES = 256
-PLAYERS = 2
-BUDGET_MS = 16.0
+BUDGET_MS = 16.0  # one 60 Hz render frame
+HEADLINE = "box_game_rollback_8f_x_256b_latency"
 
 
 def _ensure_backend() -> str:
@@ -39,10 +42,20 @@ def _ensure_backend() -> str:
         return jax.devices()[0].platform
 
 
-def main() -> None:
-    platform = _ensure_backend()
-    print(f"bench: running on {platform}", file=sys.stderr)
+def _time_rollout(ex, state, bits, iters: int = 20) -> float:
+    """Median wall ms for one full speculative rollout (compile excluded)."""
+    result = ex.run(state, 0, bits)
+    jax.block_until_ready((result.rings, result.states, result.checksums))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        result = ex.run(state, 0, bits)
+        jax.block_until_ready((result.rings, result.states, result.checksums))
+        times.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(times))
 
+
+def _box_game_case(players: int, frames: int, branches: int, seed: int = 0):
     from bevy_ggrs_tpu.models import box_game
     from bevy_ggrs_tpu.parallel.speculate import (
         SpeculativeExecutor,
@@ -51,36 +64,104 @@ def main() -> None:
     )
 
     schedule = box_game.make_schedule()
-    state = box_game.make_world(PLAYERS).commit()
-    ex = SpeculativeExecutor(schedule, BRANCHES, FRAMES)
-    key = jax.random.PRNGKey(0)
+    state = box_game.make_world(players).commit()
+    ex = SpeculativeExecutor(schedule, branches, frames)
     bits = enumerate_branches(
-        key, jnp.zeros((PLAYERS,), jnp.uint8), BRANCHES, FRAMES,
+        jax.random.PRNGKey(seed),
+        jnp.zeros((players,), jnp.uint8),
+        branches,
+        frames,
         sampler=bitmask_sampler(),
     )
-    bits = jax.block_until_ready(bits)
+    return ex, state, jax.block_until_ready(bits)
 
-    # Warmup / compile.
-    result = ex.run(state, 0, bits)
-    jax.block_until_ready((result.rings, result.states, result.checksums))
 
-    times = []
-    for _ in range(20):
-        t0 = time.perf_counter()
-        result = ex.run(state, 0, bits)
-        jax.block_until_ready((result.rings, result.states, result.checksums))
-        times.append((time.perf_counter() - t0) * 1000.0)
-    ms = float(np.median(times))
-    print(
-        json.dumps(
-            {
-                "metric": f"box_game_rollback_{FRAMES}f_x_{BRANCHES}b_latency",
-                "value": round(ms, 3),
-                "unit": "ms",
-                "vs_baseline": round(BUDGET_MS / ms, 3),
-            }
-        )
+def _boids_case(num_boids: int, players: int, frames: int, branches: int,
+                use_pallas: bool):
+    from bevy_ggrs_tpu.models import boids
+    from bevy_ggrs_tpu.parallel.speculate import (
+        SpeculativeExecutor,
+        bitmask_sampler,
+        enumerate_branches,
     )
+
+    schedule = boids.make_schedule(use_pallas=use_pallas)
+    state = boids.make_world(num_boids, players).commit()
+    ex = SpeculativeExecutor(schedule, branches, frames)
+    bits = enumerate_branches(
+        jax.random.PRNGKey(4),
+        jnp.zeros((players,), jnp.uint8),
+        branches,
+        frames,
+        sampler=bitmask_sampler(),
+    )
+    return ex, state, jax.block_until_ready(bits)
+
+
+def _entry(metric: str, ms: float, frames: int, branches: int) -> dict:
+    return {
+        "metric": metric,
+        "value": round(ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(BUDGET_MS / ms, 3),
+        "frames": frames,
+        "branches": branches,
+        "rollback_frames_per_sec": round(frames * branches / (ms / 1000.0)),
+    }
+
+
+def run_headline() -> dict:
+    ex, state, bits = _box_game_case(players=2, frames=8, branches=256)
+    ms = _time_rollout(ex, state, bits)
+    return _entry(HEADLINE, ms, 8, 256)
+
+
+def run_matrix(platform: str, headline: dict) -> list:
+    """All BASELINE.md configs. Returns the detail list (headline included)."""
+    detail = [headline]
+
+    def add(name, ex, state, bits, frames, branches):
+        ms = _time_rollout(ex, state, bits)
+        e = _entry(name, ms, frames, branches)
+        detail.append(e)
+        print(f"bench[{name}]: {ms:.3f} ms "
+              f"({e['rollback_frames_per_sec']} rollback-frames/s, "
+              f"{e['vs_baseline']}x budget)", file=sys.stderr)
+        return e
+
+    # 1: CPU-reference parity point — one branch, 4-frame recovery.
+    add("box_game_2p_4f_x_1b", *_box_game_case(2, 4, 1), 4, 1)
+    # 2: first speculative batch.
+    add("box_game_2p_8f_x_64b", *_box_game_case(2, 8, 64), 8, 64)
+    # 3: determinism-harness scale (4-player synctest shape).
+    add("box_game_4p_8f_x_256b", *_box_game_case(4, 8, 256), 8, 256)
+    # 4: entity-count scaling — 1k boids, XLA vs Pallas force kernel.
+    add("boids_1k_8f_x_128b_xla", *_boids_case(1024, 2, 8, 128, False), 8, 128)
+    add("boids_1k_8f_x_128b_pallas", *_boids_case(1024, 2, 8, 128, True), 8, 128)
+    # 5: depth × breadth stress — 8 players, 12 frames, 1024-branch tree.
+    add("box_game_8p_12f_x_1024b", *_box_game_case(8, 12, 1024), 12, 1024)
+
+    out = {
+        "platform": platform,
+        "budget_ms": BUDGET_MS,
+        "configs": detail,
+    }
+    with open("BENCH_DETAIL.json", "w") as f:
+        json.dump(out, f, indent=2)
+    print("bench: matrix written to BENCH_DETAIL.json", file=sys.stderr)
+    return detail
+
+
+def main() -> None:
+    platform = _ensure_backend()
+    print(f"bench: running on {platform}", file=sys.stderr)
+
+    headline = run_headline()
+    if "--all" in sys.argv[1:]:
+        run_matrix(platform, headline)
+
+    print(json.dumps({k: headline[k] for k in
+                      ("metric", "value", "unit", "vs_baseline")}))
 
 
 if __name__ == "__main__":

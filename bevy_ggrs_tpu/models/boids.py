@@ -96,7 +96,7 @@ def flock_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
 
     The pairwise part is a dense [N, N] interaction — on TPU this is MXU/VPU
     work that a sharded variant splits by rows over the ``entity`` mesh axis
-    (see ``bevy_ggrs_tpu.parallel.entity_sharding``).
+    (see ``bevy_ggrs_tpu.parallel.sharding.world_pspecs``).
     """
     return _flock_step(state, inputs, _pairwise_forces)
 

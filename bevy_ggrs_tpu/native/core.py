@@ -73,6 +73,7 @@ def _load():
         ctypes.c_void_p, ctypes.c_int, ctypes.c_int32, u8p]
     lib.ggrs_qs_input.restype = ctypes.c_int
     lib.ggrs_qs_discard_before.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.ggrs_qs_reset.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int32]
     lib.ggrs_qs_min_confirmed.argtypes = [ctypes.c_void_p, u8p]
     lib.ggrs_qs_min_confirmed.restype = ctypes.c_int32
     lib.ggrs_qs_gather.argtypes = [
@@ -128,6 +129,9 @@ class _NativeQueueView:
     @property
     def last_confirmed_frame(self) -> int:
         return int(_lib.ggrs_qs_last_confirmed(self._qs._ptr, self._h))
+
+    def reset(self, next_frame: int) -> None:
+        _lib.ggrs_qs_reset(self._qs._ptr, self._h, int(next_frame))
 
     def add_input(self, frame: int, bits) -> Optional[int]:
         got = int(

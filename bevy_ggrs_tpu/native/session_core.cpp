@@ -86,6 +86,16 @@ struct Queue {
       ++base;
     }
   }
+
+  // Checkpoint-restore support: forget all history and make `next_frame`
+  // the next contiguous frame add_input accepts. Prediction source resets
+  // to the zero input (the restorer replays the in-window inputs after).
+  void reset(int32_t next_frame) {
+    inputs.clear();
+    base = next_frame;
+    last_confirmed = next_frame - 1;
+    last_input = zero;
+  }
 };
 
 struct QueueSet {
@@ -159,6 +169,10 @@ int ggrs_qs_input(void* p, int handle, int32_t frame, uint8_t* out) {
 
 void ggrs_qs_discard_before(void* p, int32_t frame) {
   for (Queue& q : static_cast<QueueSet*>(p)->queues) q.discard_before(frame);
+}
+
+void ggrs_qs_reset(void* p, int handle, int32_t next_frame) {
+  static_cast<QueueSet*>(p)->queues[size_t(handle)].reset(next_frame);
 }
 
 // Highest frame confirmed for every connected player (connected[h] != 0);

@@ -84,3 +84,12 @@ class InputQueue:
         the rollback window) to bound memory."""
         for f in [f for f in self._inputs if f < frame]:
             del self._inputs[f]
+
+    def reset(self, next_frame: int) -> None:
+        """Checkpoint-restore support: forget all history and make
+        ``next_frame`` the next contiguous frame :meth:`add_input` accepts.
+        Prediction source resets to the zero input (the restorer replays the
+        in-window inputs afterwards)."""
+        self._inputs.clear()
+        self._last_confirmed = int(next_frame) - 1
+        self._last_input = self._zero

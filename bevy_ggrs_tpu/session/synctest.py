@@ -103,6 +103,48 @@ class SyncTestSession:
         status = np.full((self.num_players,), CONFIRMED, dtype=np.int32)
         return AdvanceFrame(bits=bits, status=status)
 
+    # -- checkpoint / resume -----------------------------------------------
+
+    def state_dict(self) -> Dict:
+        """JSON-serializable resumable state: frame counter plus the input
+        and checksum history inside the forced-rollback window. Everything
+        older is already GC'd (see :meth:`advance_frame`), so this is the
+        complete session state. Inputs are captured PER QUEUE through each
+        queue's own confirmed horizon — with ``input_delay`` > 0 that
+        horizon runs ``delay`` frames past ``current_frame`` (in-flight
+        delayed inputs), which a frame-window capture would drop."""
+        inputs: Dict[str, Dict[str, list]] = {}
+        lo = max(0, self.current_frame - self.check_distance - 1)
+        for h, q in enumerate(self._queues):
+            per: Dict[str, list] = {}
+            for f in range(lo, q.last_confirmed_frame + 1):
+                got = q.confirmed(f)
+                if got is not None:
+                    per[str(f)] = np.asarray(got).tolist()
+            inputs[str(h)] = per
+        return {
+            "current_frame": self.current_frame,
+            "inputs": inputs,
+            "checksums": {str(f): int(c) for f, c in self._checksums.items()},
+        }
+
+    def load_state_dict(self, sd: Dict) -> None:
+        """Restore :meth:`state_dict` output into a freshly constructed
+        session (same num_players / input_spec / check_distance /
+        input_delay). Inputs are re-inserted verbatim through the no-delay
+        path (delay was already applied before capture), so the next forced
+        rollback resimulates with exactly the original inputs."""
+        self.current_frame = int(sd["current_frame"])
+        dtype = np.dtype(self.input_spec.zeros_np(1).dtype)
+        for h, q in enumerate(self._queues):
+            per = sd["inputs"].get(str(h), {})
+            frames = sorted(int(f) for f in per)
+            q.reset(frames[0] if frames else self.current_frame)
+            for f in frames:
+                q.add_input(f, np.asarray(per[str(f)], dtype=dtype))
+        self._checksums = {int(f): int(c) for f, c in sd["checksums"].items()}
+        self._pending.clear()
+
     def report_checksum(self, frame: int, checksum: int) -> None:
         """The ``GameStateCell::save`` analog (`ggrs_stage.rs:282-283`): the
         driver reports each saved frame's checksum; a resimulated frame that

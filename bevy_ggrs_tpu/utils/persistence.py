@@ -1,0 +1,209 @@
+"""Disk checkpoint / resume for rollback sessions.
+
+The reference's checkpointing is in-memory only: a ring of ``WorldSnapshot``s
+sized to ``max_prediction`` that is never serialized (survey §5 — the
+``cell.save(frame, None, ...)`` call at
+``/root/reference/src/ggrs_stage.rs:283`` deliberately skips ggrs's byte
+buffer, and nothing is ever written to disk). This module adds the crash
+recovery the reference lacks: the runner's resumable state — device world
+state, snapshot ring, and frame counter — persists as one atomic file, and a
+rolling manager keeps the last K checkpoints of a live session.
+
+Format: a single ``.npz`` holding every pytree leaf (host numpy), keyed by
+its jax key-path string, plus a JSON header recording the path list and user
+metadata. Restore validates path/shape/dtype against a template built by the
+caller (functions and schedules are code, not data — the caller reconstructs
+those and we restore the arrays), so a checkpoint from a mismatched
+registry/capacity fails loudly instead of corrupting state. All integer
+state round-trips bitwise; float leaves are exact host copies, so a resumed
+SyncTest continues to produce the same checksums as an uninterrupted run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_HEADER_KEY = "__ggrs_header__"
+_FORMAT_VERSION = 1
+
+
+def _flatten(tree) -> Tuple[List[str], List[Any], Any]:
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    paths = [jax.tree_util.keystr(p) for p, _ in paths_leaves]
+    leaves = [leaf for _, leaf in paths_leaves]
+    return paths, leaves, treedef
+
+
+def save_checkpoint(path: str, tree, metadata: Optional[Dict] = None) -> None:
+    """Write ``tree`` (any array pytree) + ``metadata`` atomically to
+    ``path`` (``.npz``). Atomic via rename so a crash mid-write never leaves
+    a truncated checkpoint behind."""
+    paths, leaves, _ = _flatten(tree)
+    arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
+    header = json.dumps(
+        {
+            "version": _FORMAT_VERSION,
+            "paths": paths,
+            "metadata": metadata or {},
+        }
+    )
+    arrays[_HEADER_KEY] = np.frombuffer(header.encode(), dtype=np.uint8)
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_checkpoint(path: str, template) -> Tuple[Any, Dict]:
+    """Read a checkpoint into the structure of ``template``; returns
+    ``(tree, metadata)``. Every leaf is validated against the template's
+    key path, shape, and dtype before any device transfer."""
+    with np.load(path) as data:
+        header = json.loads(bytes(data[_HEADER_KEY]).decode())
+        if header.get("version") != _FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint {path!r}: format version "
+                f"{header.get('version')} != {_FORMAT_VERSION}"
+            )
+        t_paths, t_leaves, treedef = _flatten(template)
+        if header["paths"] != t_paths:
+            missing = set(t_paths) - set(header["paths"])
+            extra = set(header["paths"]) - set(t_paths)
+            raise ValueError(
+                f"checkpoint {path!r} does not match template: "
+                f"missing={sorted(missing)} extra={sorted(extra)}"
+            )
+        loaded = []
+        for i, (p, t_leaf) in enumerate(zip(t_paths, t_leaves)):
+            arr = data[f"leaf_{i}"]
+            t_arr = np.asarray(t_leaf)
+            if arr.shape != t_arr.shape or arr.dtype != t_arr.dtype:
+                raise ValueError(
+                    f"checkpoint leaf {p}: {arr.dtype}{list(arr.shape)} != "
+                    f"template {t_arr.dtype}{list(t_arr.shape)}"
+                )
+            loaded.append(jnp.asarray(arr))
+        return jax.tree_util.tree_unflatten(treedef, loaded), header["metadata"]
+
+
+# ---------------------------------------------------------------------------
+# Runner integration
+# ---------------------------------------------------------------------------
+
+
+def save_runner(
+    path: str, runner, metadata: Optional[Dict] = None, session=None
+) -> None:
+    """Persist a :class:`~bevy_ggrs_tpu.runner.RollbackRunner`'s resumable
+    state (world + ring + frame + rollback counters). Pass the driving
+    ``session`` too when it supports ``state_dict()`` (SyncTest does): its
+    frame counter and in-window input/checksum history are part of the
+    resumable whole — a session restarted at frame 0 against a restored
+    runner violates the save-frame invariant immediately."""
+    meta = dict(metadata or {})
+    meta.update(
+        frame=runner.frame,
+        rollbacks_total=runner.rollbacks_total,
+        rollback_frames_total=runner.rollback_frames_total,
+    )
+    if session is not None:
+        meta["session_state"] = session.state_dict()
+    save_checkpoint(path, {"state": runner.state, "ring": runner.ring}, meta)
+
+
+def restore_runner(path: str, runner, session=None) -> Dict:
+    """Restore ``runner`` (and optionally ``session``) in place from
+    :func:`save_runner` output; the runner must have been constructed with
+    the same registry, capacity, and ``max_prediction`` (leaf validation
+    enforces this). Returns the saved metadata."""
+    tree, meta = load_checkpoint(
+        path, {"state": runner.state, "ring": runner.ring}
+    )
+    runner.state = tree["state"]
+    runner.ring = tree["ring"]
+    runner.frame = int(meta["frame"])
+    runner.rollbacks_total = int(meta.get("rollbacks_total", 0))
+    runner.rollback_frames_total = int(meta.get("rollback_frames_total", 0))
+    if session is not None:
+        sd = meta.get("session_state")
+        if sd is None:
+            raise ValueError(
+                "checkpoint carries no session state; save with "
+                "save_runner(..., session=...) to resume a session"
+            )
+        session.load_state_dict(sd)
+    return meta
+
+
+# ---------------------------------------------------------------------------
+# Rolling checkpoint manager
+# ---------------------------------------------------------------------------
+
+
+class CheckpointManager:
+    """Rolling on-disk checkpoints of a live session.
+
+    ``maybe_save(runner)`` writes every ``interval`` frames and prunes to the
+    ``keep`` most recent; ``restore_latest(runner)`` resumes from the newest
+    intact checkpoint (skipping any that fail validation) — crash recovery
+    the reference has none of (survey §5 "No crash recovery").
+    """
+
+    _NAME = re.compile(r"^ckpt_(\d+)\.npz$")
+
+    def __init__(self, directory: str, interval: int = 60, keep: int = 3):
+        if interval <= 0 or keep <= 0:
+            raise ValueError("interval and keep must be positive")
+        self.directory = directory
+        self.interval = int(interval)
+        self.keep = int(keep)
+        os.makedirs(directory, exist_ok=True)
+
+    def _checkpoints(self) -> List[Tuple[int, str]]:
+        out = []
+        for name in os.listdir(self.directory):
+            m = self._NAME.match(name)
+            if m:
+                out.append((int(m.group(1)), os.path.join(self.directory, name)))
+        return sorted(out)
+
+    def maybe_save(
+        self, runner, metadata: Optional[Dict] = None, session=None
+    ) -> Optional[str]:
+        """Checkpoint iff ``runner.frame`` is an ``interval`` boundary not
+        yet saved; returns the path when one was written."""
+        frame = runner.frame
+        if frame == 0 or frame % self.interval:
+            return None
+        path = os.path.join(self.directory, f"ckpt_{frame}.npz")
+        if os.path.exists(path):
+            return None
+        save_runner(path, runner, metadata, session=session)
+        for _, stale in self._checkpoints()[: -self.keep]:
+            os.unlink(stale)
+        return path
+
+    def restore_latest(self, runner, session=None) -> Optional[Dict]:
+        """Restore the newest checkpoint that validates against ``runner``;
+        returns its metadata, or None when no usable checkpoint exists."""
+        for _, path in reversed(self._checkpoints()):
+            try:
+                return restore_runner(path, runner, session=session)
+            except (ValueError, OSError, KeyError):
+                continue
+        return None

@@ -1,0 +1,167 @@
+"""Disk checkpoint/resume: bitwise round-trips and crash-recovery e2e.
+
+The key property: a session restored from disk continues producing the SAME
+checksums as one that never stopped (integer state round-trips bitwise,
+float leaves are exact host copies) — so resume is invisible to the
+SyncTest determinism harness and to remote peers' desync detection.
+"""
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu.models import box_game
+from bevy_ggrs_tpu.runner import RollbackRunner
+from bevy_ggrs_tpu.session import SyncTestSession
+from bevy_ggrs_tpu.state import checksum, ring_init, ring_save
+from bevy_ggrs_tpu.utils.persistence import (
+    CheckpointManager,
+    load_checkpoint,
+    restore_runner,
+    save_checkpoint,
+    save_runner,
+)
+
+
+def test_world_state_round_trip_bitwise(tmp_path):
+    state = box_game.make_world(2).commit()
+    p = str(tmp_path / "w.npz")
+    save_checkpoint(p, state, {"note": "hello"})
+    restored, meta = load_checkpoint(p, box_game.make_world(2).commit())
+    assert meta == {"note": "hello"}
+    assert int(checksum(restored)) == int(checksum(state))
+
+
+def test_ring_round_trip(tmp_path):
+    state = box_game.make_world(2).commit()
+    ring = ring_init(state, 4)
+    ring, cs = ring_save(ring, state, 2)
+    p = str(tmp_path / "r.npz")
+    save_checkpoint(p, ring)
+    restored, _ = load_checkpoint(p, ring_init(state, 4))
+    assert int(restored.frames[2]) == 2
+    assert int(restored.checksums[2]) == int(cs)
+
+
+def test_template_mismatch_rejected(tmp_path):
+    state = box_game.make_world(2).commit()
+    p = str(tmp_path / "w.npz")
+    save_checkpoint(p, state)
+    # Different capacity → shape mismatch, loud failure.
+    other = box_game.make_world(2, capacity=32).commit()
+    with pytest.raises(ValueError, match="template"):
+        load_checkpoint(p, other)
+    # Different structure → path mismatch.
+    with pytest.raises(ValueError, match="does not match template"):
+        load_checkpoint(p, {"x": np.zeros(3)})
+
+
+def _make_pair(num_players=2, check_distance=3, max_prediction=8,
+               input_delay=0):
+    session = SyncTestSession(
+        num_players,
+        box_game.INPUT_SPEC,
+        check_distance=check_distance,
+        max_prediction=max_prediction,
+        input_delay=input_delay,
+    )
+    runner = RollbackRunner(
+        box_game.make_schedule(),
+        box_game.make_world(num_players).commit(),
+        max_prediction=max_prediction,
+        num_players=num_players,
+        input_spec=box_game.INPUT_SPEC,
+    )
+    return session, runner
+
+
+def _drive(session, runner, frames, seed_base=0, collect=None):
+    for i in range(frames):
+        for h in range(session.num_players):
+            session.add_local_input(h, np.uint8((seed_base + i + h) % 16))
+        runner.handle_requests(session.advance_frame(), session)
+        if collect is not None:
+            collect.append(int(checksum(runner.state)))
+
+
+def test_crash_recovery_resumes_bitwise(tmp_path):
+    # Run A: 30 frames straight through, recording post-frame checksums.
+    sess_a, run_a = _make_pair()
+    trace_a = []
+    _drive(sess_a, run_a, 30, collect=trace_a)
+
+    # Run B: 12 frames, checkpoint, "crash", restore into a FRESH session +
+    # runner pair (nothing survives but the file), then the remaining 18
+    # frames — exercising forced rollbacks across the crash boundary with
+    # the restored session's input history.
+    sess_b, run_b = _make_pair()
+    trace_b = []
+    _drive(sess_b, run_b, 12, collect=trace_b)
+    p = str(tmp_path / "crash.npz")
+    save_runner(p, run_b, {"who": "test"}, session=sess_b)
+
+    sess_c, run_c = _make_pair()
+    meta = restore_runner(p, run_c, session=sess_c)
+    assert meta["who"] == "test"
+    assert run_c.frame == run_b.frame
+    assert sess_c.current_frame == sess_b.current_frame
+    _drive(sess_c, run_c, 18, seed_base=12, collect=trace_b)
+
+    # Same inputs → identical checksum stream, across the crash boundary.
+    # (seed_base keeps the input schedule identical between runs.)
+    sess_d, run_d = _make_pair()
+    trace_d = []
+    _drive(sess_d, run_d, 12, collect=trace_d)
+    _drive(sess_d, run_d, 18, seed_base=12, collect=trace_d)
+    assert trace_b == trace_d
+
+
+def test_crash_recovery_with_input_delay(tmp_path):
+    """With input_delay > 0 the queues hold confirmed inputs BEYOND
+    current_frame (in-flight delayed inputs); resume must replay them, not
+    gap-fill zeros."""
+    sess_b, run_b = _make_pair(input_delay=2)
+    trace_b = []
+    # Non-repeating inputs so a dropped in-flight input changes checksums.
+    _drive(sess_b, run_b, 12, collect=trace_b)
+    p = str(tmp_path / "delay.npz")
+    save_runner(p, run_b, session=sess_b)
+
+    sess_c, run_c = _make_pair(input_delay=2)
+    restore_runner(p, run_c, session=sess_c)
+    _drive(sess_c, run_c, 18, seed_base=12, collect=trace_b)
+
+    sess_d, run_d = _make_pair(input_delay=2)
+    trace_d = []
+    _drive(sess_d, run_d, 12, collect=trace_d)
+    _drive(sess_d, run_d, 18, seed_base=12, collect=trace_d)
+    assert trace_b == trace_d
+
+
+def test_manager_rolls_and_restores(tmp_path):
+    d = str(tmp_path / "ckpts")
+    mgr = CheckpointManager(d, interval=5, keep=2)
+    session, runner = _make_pair()
+    saved = []
+    for _ in range(20):
+        for h in range(2):
+            session.add_local_input(h, np.uint8(runner.frame % 16))
+        runner.handle_requests(session.advance_frame(), session)
+        path = mgr.maybe_save(runner, session=session)
+        if path:
+            saved.append(path)
+    # Saved at frames 5, 10, 15, 20; pruned to the last 2.
+    assert len(saved) == 4
+    live = sorted(x[0] for x in mgr._checkpoints())
+    assert live == [15, 20]
+
+    fresh_sess, fresh = _make_pair()
+    meta = mgr.restore_latest(fresh, session=fresh_sess)
+    assert meta is not None and fresh.frame == 20
+    assert fresh_sess.current_frame == session.current_frame
+    assert int(checksum(fresh.state)) == int(checksum(runner.state))
+
+
+def test_manager_restore_empty_dir(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "none"), interval=5)
+    _, runner = _make_pair()
+    assert mgr.restore_latest(runner) is None
