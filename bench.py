@@ -14,11 +14,15 @@ where ``vs_baseline`` > 1 means faster than the 16 ms one-render-frame budget.
 (1: parity 4f×1b, 2: 8f×64b, 3: 4p 8f×256b, 4: 1k boids 8f×128b,
 5: 8p 12f×1024b Monte Carlo) and writes the matrix to ``BENCH_DETAIL.json``;
 per-config lines go to stderr so stdout stays a single machine-readable line.
+Each matrix config runs in its OWN subprocess (``--config NAME``) — configs
+sharing one process inflate each other 3-5x via accumulated device buffers /
+allocator pressure (observed: 0.6 ms fresh vs 123 ms after five configs).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -42,8 +46,13 @@ def _ensure_backend() -> str:
         return jax.devices()[0].platform
 
 
-def _time_rollout(ex, state, bits, iters: int = 20) -> float:
-    """Median wall ms for one full speculative rollout (compile excluded)."""
+def _time_rollout(ex, state, bits, iters: int = 20):
+    """(latency_ms, sustained_ms) for one full speculative rollout (compile
+    excluded). Latency blocks every call (what a session pays when it must
+    read the result before the render deadline); sustained pipelines
+    ``iters`` dispatches and blocks once (what a session pays in steady
+    state, where the host only syncs checksums and the next frame's dispatch
+    overlaps device compute)."""
     result = ex.run(state, 0, bits)
     jax.block_until_ready((result.rings, result.states, result.checksums))
     times = []
@@ -52,7 +61,13 @@ def _time_rollout(ex, state, bits, iters: int = 20) -> float:
         result = ex.run(state, 0, bits)
         jax.block_until_ready((result.rings, result.states, result.checksums))
         times.append((time.perf_counter() - t0) * 1000.0)
-    return float(np.median(times))
+    latency = float(np.median(times))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        result = ex.run(state, 0, bits)
+    jax.block_until_ready((result.rings, result.states, result.checksums))
+    sustained = (time.perf_counter() - t0) * 1000.0 / iters
+    return latency, float(sustained)
 
 
 def _box_game_case(players: int, frames: int, branches: int, seed: int = 0):
@@ -98,55 +113,92 @@ def _boids_case(num_boids: int, players: int, frames: int, branches: int,
     return ex, state, jax.block_until_ready(bits)
 
 
-def _entry(metric: str, ms: float, frames: int, branches: int) -> dict:
+def _entry(metric: str, ms: float, sustained: float, frames: int,
+           branches: int) -> dict:
     return {
         "metric": metric,
         "value": round(ms, 3),
         "unit": "ms",
         "vs_baseline": round(BUDGET_MS / ms, 3),
+        "sustained_ms": round(sustained, 3),
         "frames": frames,
         "branches": branches,
+        "platform": jax.devices()[0].platform,
         "rollback_frames_per_sec": round(frames * branches / (ms / 1000.0)),
+        "sustained_rollback_frames_per_sec": round(
+            frames * branches / (sustained / 1000.0)),
     }
 
 
 def run_headline() -> dict:
     ex, state, bits = _box_game_case(players=2, frames=8, branches=256)
-    ms = _time_rollout(ex, state, bits)
-    return _entry(HEADLINE, ms, 8, 256)
+    ms, sustained = _time_rollout(ex, state, bits)
+    return _entry(HEADLINE, ms, sustained, 8, 256)
+
+
+# name -> (case builder args, frames, branches); each runs in a fresh
+# subprocess under --all.
+_CONFIGS = {
+    # 1: CPU-reference parity point — one branch, 4-frame recovery.
+    "box_game_2p_4f_x_1b": (lambda: _box_game_case(2, 4, 1), 4, 1),
+    # 2: first speculative batch.
+    "box_game_2p_8f_x_64b": (lambda: _box_game_case(2, 8, 64), 8, 64),
+    # 3: determinism-harness scale (4-player synctest shape).
+    "box_game_4p_8f_x_256b": (lambda: _box_game_case(4, 8, 256), 8, 256),
+    # 4: entity-count scaling — 1k boids, XLA vs Pallas force kernel.
+    "boids_1k_8f_x_128b_xla": (lambda: _boids_case(1024, 2, 8, 128, False), 8, 128),
+    "boids_1k_8f_x_128b_pallas": (lambda: _boids_case(1024, 2, 8, 128, True), 8, 128),
+    # 5: depth × breadth stress — 8 players, 12 frames, 1024-branch tree.
+    "box_game_8p_12f_x_1024b": (lambda: _box_game_case(8, 12, 1024), 12, 1024),
+}
+
+
+def run_config(name: str) -> dict:
+    case, frames, branches = _CONFIGS[name]
+    ex, state, bits = case()
+    ms, sustained = _time_rollout(ex, state, bits)
+    return _entry(name, ms, sustained, frames, branches)
 
 
 def run_matrix(platform: str, headline: dict) -> list:
-    """All BASELINE.md configs. Returns the detail list (headline included)."""
+    """All BASELINE.md configs, one subprocess each (process isolation: a
+    shared process inflates later configs via allocator pressure). Returns
+    the detail list (headline included)."""
+    import subprocess
+
     detail = [headline]
-
-    def add(name, ex, state, bits, frames, branches):
-        ms = _time_rollout(ex, state, bits)
-        e = _entry(name, ms, frames, branches)
+    for name in _CONFIGS:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--config", name],
+            capture_output=True, text=True, cwd=os.path.dirname(
+                os.path.abspath(__file__)),
+        )
+        # Always forward child stderr: a child that silently fell back to
+        # CPU announces it only there, and its numbers must not masquerade
+        # as TPU data.
+        if proc.stderr.strip():
+            print(proc.stderr.rstrip()[-2000:], file=sys.stderr)
+        if proc.returncode != 0:
+            print(f"bench[{name}]: FAILED", file=sys.stderr)
+            continue
+        e = json.loads(proc.stdout.strip().splitlines()[-1])
+        if e.get("platform") != platform:
+            print(f"bench[{name}]: WARNING - ran on {e.get('platform')} "
+                  f"while headline ran on {platform}", file=sys.stderr)
         detail.append(e)
-        print(f"bench[{name}]: {ms:.3f} ms "
-              f"({e['rollback_frames_per_sec']} rollback-frames/s, "
-              f"{e['vs_baseline']}x budget)", file=sys.stderr)
-        return e
-
-    # 1: CPU-reference parity point — one branch, 4-frame recovery.
-    add("box_game_2p_4f_x_1b", *_box_game_case(2, 4, 1), 4, 1)
-    # 2: first speculative batch.
-    add("box_game_2p_8f_x_64b", *_box_game_case(2, 8, 64), 8, 64)
-    # 3: determinism-harness scale (4-player synctest shape).
-    add("box_game_4p_8f_x_256b", *_box_game_case(4, 8, 256), 8, 256)
-    # 4: entity-count scaling — 1k boids, XLA vs Pallas force kernel.
-    add("boids_1k_8f_x_128b_xla", *_boids_case(1024, 2, 8, 128, False), 8, 128)
-    add("boids_1k_8f_x_128b_pallas", *_boids_case(1024, 2, 8, 128, True), 8, 128)
-    # 5: depth × breadth stress — 8 players, 12 frames, 1024-branch tree.
-    add("box_game_8p_12f_x_1024b", *_box_game_case(8, 12, 1024), 12, 1024)
+        print(f"bench[{name}]: {e['value']:.3f} ms latency / "
+              f"{e['sustained_ms']:.3f} ms sustained "
+              f"({e['sustained_rollback_frames_per_sec']} rollback-frames/s, "
+              f"{e['vs_baseline']}x budget) [{e.get('platform')}]",
+              file=sys.stderr)
 
     out = {
         "platform": platform,
         "budget_ms": BUDGET_MS,
         "configs": detail,
     }
-    with open("BENCH_DETAIL.json", "w") as f:
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_DETAIL.json"), "w") as f:
         json.dump(out, f, indent=2)
     print("bench: matrix written to BENCH_DETAIL.json", file=sys.stderr)
     return detail
@@ -156,8 +208,18 @@ def main() -> None:
     platform = _ensure_backend()
     print(f"bench: running on {platform}", file=sys.stderr)
 
+    args = sys.argv[1:]
+    if "--config" in args:
+        idx = args.index("--config") + 1
+        if idx >= len(args) or args[idx] not in _CONFIGS:
+            print(f"bench: --config needs one of: {', '.join(_CONFIGS)}",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        print(json.dumps(run_config(args[idx])))
+        return
+
     headline = run_headline()
-    if "--all" in sys.argv[1:]:
+    if "--all" in args:
         run_matrix(platform, headline)
 
     print(json.dumps({k: headline[k] for k in

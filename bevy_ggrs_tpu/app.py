@@ -148,20 +148,34 @@ class GGRSStage:
         update_frequency: int = DEFAULT_FPS,
         clock=None,
         metrics=None,
+        speculation: Optional[int] = None,
     ):
         from bevy_ggrs_tpu.utils.metrics import null_metrics
 
         self.metrics = metrics if metrics is not None else null_metrics
         self.input_system = input_system
         self.update_frequency = int(update_frequency)
-        self.runner = RollbackRunner(
-            schedule,
-            initial_state,
-            max_prediction=max_prediction,
-            num_players=num_players,
-            input_spec=input_spec,
-            metrics=self.metrics,
-        )
+        if speculation:
+            from bevy_ggrs_tpu.spec_runner import SpeculativeRollbackRunner
+
+            self.runner = SpeculativeRollbackRunner(
+                schedule,
+                initial_state,
+                max_prediction=max_prediction,
+                num_players=num_players,
+                input_spec=input_spec,
+                num_branches=speculation,
+                metrics=self.metrics,
+            )
+        else:
+            self.runner = RollbackRunner(
+                schedule,
+                initial_state,
+                max_prediction=max_prediction,
+                num_players=num_players,
+                input_spec=input_spec,
+                metrics=self.metrics,
+            )
         self._clock = clock if clock is not None else _time.monotonic
         # Compile the rollout executable now, before any session handshake:
         # a first-frame compile stall on a slow host can blow through the
@@ -239,6 +253,9 @@ class GGRSStage:
             self.frames_skipped += 1  # `ggrs_stage.rs:251-253`: skip + log
             return
         self.runner.handle_requests(requests, session)
+        speculate = getattr(self.runner, "speculate", None)
+        if speculate is not None:
+            speculate(session.confirmed_frame())
 
     def _step_spectator(self, app: RollbackApp) -> None:
         session: SpectatorSession = app.session
@@ -267,6 +284,7 @@ class GGRSPlugin:
         self._setup: Optional[Callable[[HostWorld, RollbackApp], None]] = None
         self.clock = None
         self.metrics = None
+        self.speculation: Optional[int] = None
 
     def with_update_frequency(self, fps: int) -> "GGRSPlugin":
         self.update_frequency = int(fps)
@@ -324,6 +342,13 @@ class GGRSPlugin:
         self.metrics = metrics
         return self
 
+    def with_speculation(self, num_branches: int) -> "GGRSPlugin":
+        """Precompute rollback recoveries with a ``num_branches``-wide
+        speculative rollout each frame (P2P only; see
+        :mod:`bevy_ggrs_tpu.spec_runner`). 0/None disables."""
+        self.speculation = int(num_branches) or None
+        return self
+
     def build(self, app: Optional[RollbackApp] = None) -> RollbackApp:
         if self.input_system is None:
             # Parity with the reference's explicit panic (`lib.rs:157-159`).
@@ -342,5 +367,6 @@ class GGRSPlugin:
             update_frequency=self.update_frequency,
             clock=self.clock,
             metrics=self.metrics,
+            speculation=self.speculation,
         )
         return app

@@ -26,6 +26,7 @@ from __future__ import annotations
 import math
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -196,21 +197,24 @@ def pairwise_force_rows(
     """
     diff = row_pos[:, None, :] - all_pos[None, :, :]  # [R, N, 2]
     d2 = jnp.sum(diff * diff, axis=2)  # [R, N]
-    d = jnp.sqrt(jnp.maximum(d2, jnp.float32(1e-12)))
 
     both = row_active[:, None] * all_active[None, :]
     is_self = d2 < jnp.float32(1e-10)
+    # Neighborhood membership on d² (identical float values to the Pallas
+    # kernel's masks, so borderline pairs classify the same on both paths);
+    # 1/d via one rsqrt — no sqrt/divide on the [R, N] inner tensors.
     neigh = (
         both
-        * (d < jnp.float32(NEIGHBOR_RADIUS)).astype(jnp.float32)
+        * (d2 < jnp.float32(NEIGHBOR_RADIUS) ** 2).astype(jnp.float32)
         * (1.0 - is_self.astype(jnp.float32))
     )  # [R, N]
     n_neigh = jnp.sum(neigh, axis=1, keepdims=True)  # [R, 1]
     n_safe = jnp.maximum(n_neigh, jnp.float32(1.0))
 
     # Separation: push away from too-close neighbors, 1/d weighted.
-    close = neigh * (d < jnp.float32(SEPARATION_RADIUS)).astype(jnp.float32)
-    sep = jnp.sum(diff / d[:, :, None] * close[:, :, None], axis=1)
+    inv_d = jax.lax.rsqrt(jnp.maximum(d2, jnp.float32(1e-12)))
+    close = neigh * (d2 < jnp.float32(SEPARATION_RADIUS) ** 2).astype(jnp.float32)
+    sep = jnp.sum(diff * inv_d[:, :, None] * close[:, :, None], axis=1)
 
     # Alignment: match neighborhood mean velocity.
     mean_vel = jnp.sum(all_vel[None, :, :] * neigh[:, :, None], axis=1) / n_safe

@@ -61,13 +61,19 @@ def _force_kernel(
     dx = rpx[...] - cpx[...]  # [R_BLK, C_BLK]
     dy = rpy[...] - cpy[...]
     d2 = dx * dx + dy * dy
-    d = jnp.sqrt(jnp.maximum(d2, jnp.float32(1e-12)))
     both = ra[...] * ca[...]
+    # Membership tests on d² (identical float values to the XLA path's, so
+    # borderline pairs classify the same); 1/d via one rsqrt — no sqrt or
+    # divide in the inner loop.
     not_self = one - (d2 < jnp.float32(1e-10)).astype(jnp.float32)
-    neigh = both * (d < jnp.float32(neighbor_radius)).astype(jnp.float32) * not_self
-    close = neigh * (d < jnp.float32(separation_radius)).astype(jnp.float32)
+    neigh = (
+        both
+        * (d2 < jnp.float32(neighbor_radius) ** 2).astype(jnp.float32)
+        * not_self
+    )
+    close = neigh * (d2 < jnp.float32(separation_radius) ** 2).astype(jnp.float32)
 
-    inv_d = one / d
+    inv_d = jax.lax.rsqrt(jnp.maximum(d2, jnp.float32(1e-12)))
     acc_n[...] += jnp.sum(neigh, axis=1, keepdims=True)
     acc_sx[...] += jnp.sum(dx * inv_d * close, axis=1, keepdims=True)
     acc_sy[...] += jnp.sum(dy * inv_d * close, axis=1, keepdims=True)

@@ -69,6 +69,9 @@ class RollbackRunner:
         self.report_checksums = report_checksums
         self.rollback_frames_total = 0  # observability: resimulated frames
         self.rollbacks_total = 0
+        # Optional as-used input log frame -> bits host array, maintained for
+        # the speculative runner's branch matching (None = disabled).
+        self._input_log: Optional[dict] = None
 
     # ------------------------------------------------------------------
 
@@ -118,6 +121,8 @@ class RollbackRunner:
                 )
             save_frames.append(step.save_frame)
             if step.adv is not None:
+                if self._input_log is not None:
+                    self._input_log[frame] = np.asarray(step.adv.bits)
                 frame += 1
 
         n = len(steps)

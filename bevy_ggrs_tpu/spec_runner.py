@@ -1,0 +1,279 @@
+"""SpeculativeRollbackRunner: misprediction recovery as a branch select.
+
+The reference (and the base :class:`~bevy_ggrs_tpu.runner.RollbackRunner`)
+pays for a misprediction *after* it is detected: the session emits
+``[Load(F_bad), (Save, Advance) × k]`` and the driver resimulates
+(`/root/reference/src/ggrs_stage.rs:259-269` — serial there, one fused scan
+here). This runner spends idle device time *before* the misprediction:
+after every tick it dispatches (asynchronously) a B-branch speculative
+rollout from the confirmed frontier — candidate input futures sampled
+around repeat-last (branch 0 IS repeat-last, so the engine strictly
+contains the reference's prediction policy). When a rollback burst arrives,
+it checks whether some branch's inputs match the corrected history exactly;
+on a hit, recovery is a gather of that branch's precomputed ring/state —
+no resimulation on the critical path — and on a miss it falls back to the
+fused serial burst, bit-for-bit identical semantics either way.
+
+Speculation is semantically invisible: the states, ring contents, and
+reported checksums after a hit are exactly what the fallback would have
+produced, because a branch only commits when its input tensor matches the
+corrected inputs frame-for-frame (and as-used inputs from the anchor up to
+the load frame — the rollout started at the anchor, so its trajectory is
+only valid if every frame since matches). One constraint, documented and
+deliberate: game systems must not read ``PlayerInputs.status`` into state
+(speculative rollouts run all-PREDICTED; the reference gives systems the
+same visibility, so a status-dependent game would diverge under ANY
+prediction scheme — its own SyncTest would flag it).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bevy_ggrs_tpu.parallel.speculate import (
+    SpecResult,
+    SpeculativeExecutor,
+    bitmask_sampler,
+    enumerate_branches,
+    match_branch,
+)
+from bevy_ggrs_tpu.runner import RollbackRunner, _Step
+from bevy_ggrs_tpu.schedule import Schedule
+from bevy_ggrs_tpu.state import SnapshotRing, WorldState, ring_load
+
+
+@functools.partial(jax.jit, static_argnames=("max_steps",))
+def _absorb(
+    main_ring: SnapshotRing,
+    spec_ring: SnapshotRing,  # the matched branch's ring (no branch axis)
+    spec_states: WorldState,  # the matched branch's final state
+    first_frame: jnp.ndarray,  # first replayed frame (the Load target)
+    n_frames: jnp.ndarray,  # how many (save, advance) steps were replayed
+    anchor: jnp.ndarray,  # spec rollout start frame
+    total_spec: jnp.ndarray,  # frames the spec rollout simulated in total
+    max_steps: int,
+):
+    """Copy frames ``first_frame .. first_frame+n_frames-1`` from the
+    branch ring into the main ring and return (ring, state-at-end,
+    checksums[max_steps]). The state after the last replayed frame is the
+    branch ring's NEXT slot (state entering frame f is saved at f) or the
+    rollout's final state when the replay consumed the whole rollout."""
+
+    def body(carry, t):
+        ring = carry
+        f = first_frame + t
+        valid = t < n_frames
+        st = ring_load(spec_ring, f)
+        cs = spec_ring.checksums[jnp.remainder(f, spec_ring.depth)]
+        slot = jnp.remainder(f, ring.depth)
+        new_states = jax.tree_util.tree_map(
+            lambda r, s: jnp.where(
+                valid,
+                jax.lax.dynamic_update_index_in_dim(r, s, slot, 0),
+                r,
+            ),
+            ring.states,
+            st,
+        )
+        ring = SnapshotRing(
+            states=new_states,
+            frames=jnp.where(valid, ring.frames.at[slot].set(f), ring.frames),
+            checksums=jnp.where(
+                valid, ring.checksums.at[slot].set(cs), ring.checksums
+            ),
+        )
+        return ring, jnp.where(valid, cs, jnp.uint32(0))
+
+    main_ring, checksums = jax.lax.scan(
+        body, main_ring, jnp.arange(max_steps, dtype=jnp.int32)
+    )
+    end = first_frame + n_frames  # frame entered after the replay
+    # State entering `end`: saved in the branch ring unless the replay ran
+    # through the rollout's entire span, in which case it's the final state.
+    in_ring = end < anchor + total_spec
+    from_ring = ring_load(spec_ring, end)
+    state = jax.tree_util.tree_map(
+        lambda a, b: jnp.where(in_ring, a, b), from_ring, spec_states
+    )
+    return main_ring, state, checksums
+
+
+class SpeculativeRollbackRunner(RollbackRunner):
+    """Drop-in :class:`RollbackRunner` that precomputes rollback recoveries.
+
+    Extra knobs: ``num_branches`` (candidate futures per rollout),
+    ``sampler`` (branch enumeration policy, default the sticky bitmask
+    tree), ``spec_frames`` (rollout depth, default ``max_prediction``).
+    Call :meth:`speculate` once per tick after ``handle_requests`` with the
+    session's confirmed frame. Hit/miss counts land in ``spec_hits`` /
+    ``spec_misses`` and the metrics sink.
+    """
+
+    def __init__(
+        self,
+        schedule: Schedule,
+        initial_state: WorldState,
+        max_prediction: int,
+        num_players: int,
+        input_spec,
+        num_branches: int = 64,
+        sampler=None,
+        spec_frames: Optional[int] = None,
+        seed: int = 0,
+        **kwargs,
+    ):
+        super().__init__(
+            schedule, initial_state, max_prediction, num_players, input_spec,
+            **kwargs,
+        )
+        self.spec_frames = int(spec_frames or max_prediction)
+        self.num_branches = int(num_branches)
+        self._sampler = sampler or bitmask_sampler()
+        self._spec = SpeculativeExecutor(
+            schedule, self.num_branches, self.spec_frames
+        )
+        self._key = jax.random.PRNGKey(seed)
+        self._result: Optional[SpecResult] = None
+        self._input_log = {}  # as-used inputs, frame -> bits (host)
+        self.spec_hits = 0
+        self.spec_misses = 0
+        self.rollback_frames_recovered_total = 0
+
+    def warmup(self) -> None:
+        """Compile the serial executor AND the speculative pipeline
+        (rollout, branch commit, ring absorb) before the session handshake —
+        a first-speculation compile mid-session would stall the tick loop
+        past the peer disconnect timeout, the exact failure the base
+        warmup exists to prevent."""
+        super().warmup()
+        bits = jnp.zeros(
+            (self.num_branches, self.spec_frames)
+            + self.input_spec.zeros_np(self.num_players).shape,
+            dtype=self.input_spec.zeros_np(1).dtype,
+        )
+        res = self._spec.run(self.state, 0, bits)
+        spec_ring, spec_state = self._spec.commit(res, 0)
+        # n_frames=0: absorbs nothing — compiles without touching state.
+        _absorb(
+            self.ring, spec_ring, spec_state,
+            jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+            jnp.asarray(0, jnp.int32), jnp.asarray(res.num_frames, jnp.int32),
+            max_steps=self.executor.max_frames,
+        )
+
+    # ------------------------------------------------------------------
+
+    def handle_requests(self, requests, session=None) -> None:
+        segments = self._segment(requests)
+        for load_frame, steps in segments:
+            if load_frame is not None and self._try_commit(
+                load_frame, steps, session
+            ):
+                continue
+            self._run_segment(load_frame, steps, session)
+        self._gc_log()
+
+    def speculate(self, confirmed_frame: int) -> None:
+        """Dispatch the next rollout from the confirmed frontier (frame
+        ``confirmed_frame + 1``). Async: returns as soon as the device call
+        is enqueued; the result is consumed by a later rollback. Call after
+        :meth:`handle_requests` each tick."""
+        anchor = confirmed_frame + 1
+        if anchor > self.frame:
+            self._result = None  # fully confirmed: nothing to speculate
+            return
+        if anchor <= self.frame - self.ring.depth:
+            self._result = None  # anchor fell out of the ring
+            return
+        last = self._input_log.get(anchor - 1)
+        if last is None:
+            last = self.input_spec.zeros_np(self.num_players)
+        self._key, sub = jax.random.split(self._key)
+        bits = enumerate_branches(
+            sub,
+            jnp.asarray(last),
+            self.num_branches,
+            self.spec_frames,
+            sampler=self._sampler,
+        )
+        # anchor == self.frame: the current live state IS the anchor state
+        # (not yet ring-saved); otherwise gather it from the ring.
+        state = (
+            self.state if anchor == self.frame else ring_load(self.ring, anchor)
+        )
+        with self.metrics.timer("speculate_dispatch"):
+            self._result = self._spec.run(state, anchor, bits)
+
+    # ------------------------------------------------------------------
+
+    def _try_commit(self, load_frame: int, steps: List[_Step], session) -> bool:
+        """Commit a matching branch for a ``[Load, (Save, Advance)*]``
+        burst; returns False (→ serial fallback) when no branch matches."""
+        res = self._result
+        if res is None or not steps:
+            return False
+        anchor = res.start_frame
+        n_steps = len(steps)
+        end = load_frame + n_steps  # frame entered after the burst
+        if load_frame < anchor or end > anchor + res.num_frames:
+            return False
+        # The standard recovery burst is save+advance every step; anything
+        # else (e.g. spectator-style advance-only) takes the generic path.
+        if any(s.adv is None or s.save_frame is None for s in steps):
+            return False
+        # Required input trajectory from the anchor: as-used inputs for
+        # frames that survived the rollback, then the corrected inputs.
+        needed = []
+        for f in range(anchor, load_frame):
+            got = self._input_log.get(f)
+            if got is None:
+                return False
+            needed.append(got)
+        needed.extend(np.asarray(s.adv.bits) for s in steps)
+        needed_arr = np.stack(needed)  # [k, P, ...]
+        branch, depth = match_branch(np.asarray(res.branch_bits), needed_arr)
+        if depth < needed_arr.shape[0]:  # v1 commits full matches only
+            self.spec_misses += 1
+            self.metrics.count("spec_misses")
+            return False
+
+        with self.metrics.timer("spec_commit"):
+            spec_ring, spec_state = self._spec.commit(res, branch)
+            self.ring, self.state, checksums = _absorb(
+                self.ring,
+                spec_ring,
+                spec_state,
+                jnp.asarray(load_frame, jnp.int32),
+                jnp.asarray(n_steps, jnp.int32),
+                jnp.asarray(anchor, jnp.int32),
+                jnp.asarray(res.num_frames, jnp.int32),
+                max_steps=self.executor.max_frames,
+            )
+        if session is not None and self.report_checksums:
+            cs_host = np.asarray(checksums)
+            for t in range(n_steps):
+                session.report_checksum(load_frame + t, int(cs_host[t]))
+        for t, s in enumerate(steps):
+            self._input_log[load_frame + t] = np.asarray(s.adv.bits)
+        self.frame = end
+        self.spec_hits += 1
+        self.metrics.count("spec_hits")
+        self.rollbacks_total += 1
+        # NOT added to rollback_frames_total: these frames were never
+        # resimulated — that is the whole point of the hit.
+        self.rollback_frames_recovered_total += n_steps
+        self.metrics.count("rollbacks")
+        self.metrics.count("rollback_frames_recovered", n_steps)
+        self.metrics.count("frames_advanced", n_steps)
+        self.metrics.observe("rollback_depth", n_steps)
+        return True
+
+    def _gc_log(self) -> None:
+        horizon = self.frame - self.ring.depth - 1
+        for f in [f for f in self._input_log if f < horizon]:
+            del self._input_log[f]

@@ -31,7 +31,8 @@ def force_platform(platform: str) -> None:
 import numpy as np  # noqa: E402
 
 
-def build_app(num_players: int, max_prediction: int, fps: int, input_fn, clock=None):
+def build_app(num_players: int, max_prediction: int, fps: int, input_fn,
+              clock=None, speculation: int = 0):
     from bevy_ggrs_tpu.app import GGRSPlugin
     from bevy_ggrs_tpu.models import box_game
     import jax.numpy as jnp
@@ -59,6 +60,8 @@ def build_app(num_players: int, max_prediction: int, fps: int, input_fn, clock=N
     )
     if clock is not None:
         plugin.with_clock(clock)
+    if speculation:
+        plugin.with_speculation(speculation)
     return plugin.build()
 
 

@@ -47,6 +47,9 @@ def main() -> int:
     parser.add_argument("--max-prediction", type=int, default=12)
     parser.add_argument("--disconnect-timeout", type=float, default=5.0,
                         help="seconds of peer silence before disconnect")
+    parser.add_argument("--speculate", type=int, default=0, metavar="B",
+                        help="precompute rollback recoveries with B "
+                             "speculative input branches per frame (0 = off)")
     add_common_args(parser)
     args = parser.parse_args()
     force_platform(args.platform)
@@ -75,7 +78,8 @@ def main() -> int:
 
     # Build (and JIT-compile) the app BEFORE binding the socket, so the
     # handshake starts only when we can actually service it.
-    app = build_app(num_players, args.max_prediction, args.fps, scripted_input)
+    app = build_app(num_players, args.max_prediction, args.fps, scripted_input,
+                    speculation=args.speculate)
     socket = UdpSocket.bind_to_port(args.local_port)
     session = builder.start_p2p_session(socket)
     app.insert_session(session, SessionType.P2P)
@@ -89,9 +93,15 @@ def main() -> int:
         lead = dt - (time.monotonic() - t0)
         if lead > 0:
             time.sleep(lead)
+    extra = ""
+    if args.speculate:
+        extra = (f", spec_hits={app.stage.runner.spec_hits}"
+                 f", spec_misses={app.stage.runner.spec_misses}"
+                 f", recovered={app.stage.runner.rollback_frames_recovered_total}")
     print_world(app, f"p2p done after {app.frame} sim frames "
                      f"(rollbacks={app.stage.runner.rollbacks_total}, "
-                     f"resimulated={app.stage.runner.rollback_frames_total})")
+                     f"resimulated={app.stage.runner.rollback_frames_total}"
+                     f"{extra})")
     return 0
 
 

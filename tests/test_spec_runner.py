@@ -1,0 +1,222 @@
+"""SpeculativeRollbackRunner: recovery-as-select must be invisible.
+
+Two layers: request-level unit tests crafting exact rollback bursts against
+a hand-built branch tensor (hit, miss, partial-span, anchor-offset cases),
+asserting bitwise equality with the serial runner and correct hit/miss
+accounting; and a full two-peer loopback session where one peer speculates
+— confirmed checksum streams must match the all-serial universe exactly.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from bevy_ggrs_tpu.models import box_game
+from bevy_ggrs_tpu.runner import RollbackRunner
+from bevy_ggrs_tpu.session.requests import AdvanceFrame, LoadGameState, SaveGameState
+from bevy_ggrs_tpu.spec_runner import SpeculativeRollbackRunner
+from bevy_ggrs_tpu.state import checksum
+
+P = 2
+MAXPRED = 8
+
+
+def fixed_sampler(tensor):
+    """A sampler that always returns ``tensor`` ([B, F, P] uint8)."""
+    t = jnp.asarray(tensor)
+
+    def sample(key, last_bits, num_branches, num_frames):
+        assert t.shape[0] == num_branches and t.shape[1] == num_frames
+        return t
+    return sample
+
+
+def make_runners(sampler=None, num_branches=4, spec_frames=4):
+    serial = RollbackRunner(
+        box_game.make_schedule(), box_game.make_world(P).commit(),
+        max_prediction=MAXPRED, num_players=P, input_spec=box_game.INPUT_SPEC,
+    )
+    spec = SpeculativeRollbackRunner(
+        box_game.make_schedule(), box_game.make_world(P).commit(),
+        max_prediction=MAXPRED, num_players=P, input_spec=box_game.INPUT_SPEC,
+        num_branches=num_branches, sampler=sampler, spec_frames=spec_frames,
+    )
+    return serial, spec
+
+
+def adv(bits):
+    return AdvanceFrame(
+        bits=np.asarray(bits, np.uint8), status=np.zeros(P, np.int32)
+    )
+
+
+def step_requests(frame, bits):
+    return [SaveGameState(frame), adv(bits)]
+
+
+def rollback_requests(load, corrected):
+    """[Load, (Save, Adv)×k] replaying ``corrected`` from frame ``load``."""
+    reqs = [LoadGameState(load)]
+    for t, bits in enumerate(corrected):
+        reqs += [SaveGameState(load + t), adv(bits)]
+    return reqs
+
+
+class ChecksumLog:
+    def __init__(self):
+        self.seen = {}
+
+    def report_checksum(self, frame, cs):
+        self.seen[frame] = int(cs)
+
+
+def run_both(serial, spec, script):
+    """Apply the same request script to both runners (spec speculates when
+    the script says so); returns their checksum logs."""
+    logs = (ChecksumLog(), ChecksumLog())
+    for item in script:
+        if item[0] == "reqs":
+            serial.handle_requests(item[1], logs[0])
+            spec.handle_requests(item[1], logs[1])
+        elif item[0] == "speculate":
+            spec.speculate(item[1])
+    assert serial.frame == spec.frame
+    assert int(checksum(serial.state)) == int(checksum(spec.state))
+    assert logs[0].seen == logs[1].seen
+    return logs
+
+
+def test_full_span_hit():
+    # Frames 0..2 advance normally; speculate from anchor 3 (confirmed=2);
+    # frames 3,4 advance (predicted); rollback Load(3) replays corrected
+    # inputs that branch 2 of the tensor predicts exactly.
+    corrected = np.array([[[1, 4]], [[1, 8]], [[1, 2]]], np.uint8).reshape(3, P)
+    tensor = np.zeros((4, 4, P), np.uint8)
+    tensor[2, :3] = corrected
+    tensor[2, 3] = [9, 9]  # unused tail frame of the rollout
+    serial, spec = make_runners(fixed_sampler(tensor), 4, 4)
+
+    script = [("reqs", step_requests(f, [f, f + 1])) for f in range(3)]
+    script.append(("speculate", 2))
+    script.append(("reqs", step_requests(3, [3, 4])))
+    script.append(("reqs", step_requests(4, [4, 5])))
+    script.append(("reqs", rollback_requests(3, list(corrected))))
+    run_both(serial, spec, script)
+    assert spec.spec_hits == 1 and spec.spec_misses == 0
+
+
+def test_miss_falls_back_serial():
+    tensor = np.full((4, 4, P), 13, np.uint8)  # never matches
+    serial, spec = make_runners(fixed_sampler(tensor), 4, 4)
+    script = [("reqs", step_requests(f, [f, f + 1])) for f in range(3)]
+    script.append(("speculate", 2))
+    script.append(("reqs", step_requests(3, [3, 4])))
+    script.append(("reqs", rollback_requests(3, [[5, 6], [6, 7]])))
+    run_both(serial, spec, script)
+    assert spec.spec_hits == 0 and spec.spec_misses == 1
+
+
+def test_partial_span_hit_load_after_anchor():
+    # Anchor 2 but rollback loads at 4: the branch must ALSO match the
+    # as-used inputs for frames 2..3 for its trajectory to be valid.
+    used = {2: [2, 3], 3: [3, 4]}
+    corrected = [[11, 1], [12, 2]]
+    tensor = np.zeros((2, 4, P), np.uint8)
+    tensor[1, 0] = used[2]
+    tensor[1, 1] = used[3]
+    tensor[1, 2] = corrected[0]
+    tensor[1, 3] = corrected[1]
+    serial, spec = make_runners(fixed_sampler(tensor), 2, 4)
+    script = [("reqs", step_requests(f, [f, f + 1])) for f in range(2)]
+    script.append(("speculate", 1))  # anchor = 2
+    for f in (2, 3, 4):
+        script.append(("reqs", step_requests(f, used.get(f, [4, 5]))))
+    script.append(("reqs", rollback_requests(4, corrected)))
+    run_both(serial, spec, script)
+    assert spec.spec_hits == 1
+
+
+def test_trajectory_mismatch_before_load_is_a_miss():
+    # Branch matches the corrected span but NOT the as-used frame between
+    # anchor and load — committing it would adopt a wrong trajectory, so it
+    # must miss.
+    corrected = [[11, 1]]
+    tensor = np.zeros((2, 4, P), np.uint8)
+    tensor[1, 0] = [99, 99]  # contradicts as-used inputs of frame 2
+    tensor[1, 1] = corrected[0]
+    serial, spec = make_runners(fixed_sampler(tensor), 2, 4)
+    script = [("reqs", step_requests(f, [f, f + 1])) for f in range(2)]
+    script.append(("speculate", 1))  # anchor = 2
+    script.append(("reqs", step_requests(2, [2, 3])))
+    script.append(("reqs", step_requests(3, [3, 4])))
+    script.append(("reqs", rollback_requests(3, corrected)))
+    run_both(serial, spec, script)
+    assert spec.spec_hits == 0 and spec.spec_misses == 1
+
+
+def test_hit_through_rollout_end_uses_final_state():
+    # Replay consumes the rollout's entire span: the committed state must be
+    # the rollout's final state, not a ring slot.
+    corrected = np.array([[5, 1], [6, 2], [7, 3], [8, 4]], np.uint8)
+    tensor = np.zeros((2, 4, P), np.uint8)
+    tensor[0] = corrected
+    serial, spec = make_runners(fixed_sampler(tensor), 2, 4)
+    script = [("reqs", step_requests(f, [f, f + 1])) for f in range(3)]
+    script.append(("speculate", 2))  # anchor = 3, rollout covers 3..6
+    for f in (3, 4, 5, 6):
+        script.append(("reqs", step_requests(f, [f, f + 1])))
+    script.append(("reqs", rollback_requests(3, list(corrected))))
+    run_both(serial, spec, script)
+    assert spec.spec_hits == 1
+
+
+def test_loopback_session_equivalence():
+    """Full P2P run: peer 0 speculating must produce exactly the checksum
+    stream of the all-serial universe (hits or not)."""
+    from tests.test_p2p import (
+        FPS_DT, common_confirmed_checksums, make_pair, scripted_input,
+    )
+    from bevy_ggrs_tpu.session import PredictionThreshold, SessionState
+    from bevy_ggrs_tpu.transport.loopback import LoopbackNetwork
+
+    def drive_universe(speculate: bool):
+        net = LoopbackNetwork(latency=2.5 * FPS_DT, seed=11)
+        peers = make_pair(net, max_prediction=8)
+        if speculate:
+            session0, _ = peers[0]
+            spec_runner = SpeculativeRollbackRunner(
+                box_game.make_schedule(), box_game.make_world(2).commit(),
+                max_prediction=8, num_players=2,
+                input_spec=box_game.INPUT_SPEC,
+                num_branches=16, spec_frames=8, seed=3,
+            )
+            peers[0] = (session0, spec_runner)
+        for _ in range(60):
+            net.advance(FPS_DT)
+            for session, runner in peers:
+                session.poll_remote_clients()
+                if session.current_state() != SessionState.RUNNING:
+                    continue
+                for h in session.local_player_handles():
+                    session.add_local_input(
+                        h, scripted_input(h, session.current_frame)
+                    )
+                try:
+                    requests = session.advance_frame()
+                except PredictionThreshold:
+                    continue
+                runner.handle_requests(requests, session)
+                if isinstance(runner, SpeculativeRollbackRunner):
+                    runner.speculate(session.confirmed_frame())
+        return peers
+
+    serial_peers = drive_universe(False)
+    spec_peers = drive_universe(True)
+    f1, cs1 = common_confirmed_checksums(serial_peers)
+    f2, cs2 = common_confirmed_checksums(spec_peers)
+    assert f1 and f1 == f2
+    # Within each universe both peers agree; across universes identical.
+    assert all(a == b for a, b in cs1)
+    assert all(a == b for a, b in cs2)
+    assert cs1 == cs2
+    spec_runner = spec_peers[0][1]
+    assert spec_runner.rollbacks_total > 0  # rollbacks actually happened
