@@ -137,8 +137,12 @@ def run_headline() -> dict:
 
 
 # name -> (case builder args, frames, branches); each runs in a fresh
-# subprocess under --all.
+# subprocess under --all. The headline is listed first so the matrix run
+# measures it in its own subprocess as well (the parent never touches the
+# accelerator in --all mode — a parent holding an exclusive TPU claim
+# would silently push every child onto CPU).
 _CONFIGS = {
+    HEADLINE: (lambda: _box_game_case(2, 8, 256), 8, 256),
     # 1: CPU-reference parity point — one branch, 4-frame recovery.
     "box_game_2p_4f_x_1b": (lambda: _box_game_case(2, 4, 1), 4, 1),
     # 2: first speculative batch.
@@ -160,13 +164,14 @@ def run_config(name: str) -> dict:
     return _entry(name, ms, sustained, frames, branches)
 
 
-def run_matrix(platform: str, headline: dict) -> list:
-    """All BASELINE.md configs, one subprocess each (process isolation: a
-    shared process inflates later configs via allocator pressure). Returns
-    the detail list (headline included)."""
+def run_matrix() -> list:
+    """All BASELINE.md configs (headline first), one subprocess each
+    (process isolation: a shared process inflates later configs via
+    allocator pressure). Returns the detail list."""
     import subprocess
 
-    detail = [headline]
+    detail = []
+    platform = None
     for name in _CONFIGS:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--config", name],
@@ -182,9 +187,10 @@ def run_matrix(platform: str, headline: dict) -> list:
             print(f"bench[{name}]: FAILED", file=sys.stderr)
             continue
         e = json.loads(proc.stdout.strip().splitlines()[-1])
+        platform = platform or e.get("platform")
         if e.get("platform") != platform:
             print(f"bench[{name}]: WARNING - ran on {e.get('platform')} "
-                  f"while headline ran on {platform}", file=sys.stderr)
+                  f"while the headline ran on {platform}", file=sys.stderr)
         detail.append(e)
         print(f"bench[{name}]: {e['value']:.3f} ms latency / "
               f"{e['sustained_ms']:.3f} ms sustained "
@@ -205,9 +211,6 @@ def run_matrix(platform: str, headline: dict) -> list:
 
 
 def main() -> None:
-    platform = _ensure_backend()
-    print(f"bench: running on {platform}", file=sys.stderr)
-
     args = sys.argv[1:]
     if "--config" in args:
         idx = args.index("--config") + 1
@@ -215,12 +218,22 @@ def main() -> None:
             print(f"bench: --config needs one of: {', '.join(_CONFIGS)}",
                   file=sys.stderr)
             raise SystemExit(2)
+        platform = _ensure_backend()
+        print(f"bench: running on {platform}", file=sys.stderr)
         print(json.dumps(run_config(args[idx])))
         return
 
-    headline = run_headline()
     if "--all" in args:
-        run_matrix(platform, headline)
+        # Parent stays off the accelerator; every config (headline
+        # included) measures in its own subprocess.
+        detail = run_matrix()
+        headline = detail[0] if detail else None
+        if headline is None:
+            raise SystemExit("bench: all configs failed")
+    else:
+        platform = _ensure_backend()
+        print(f"bench: running on {platform}", file=sys.stderr)
+        headline = run_headline()
 
     print(json.dumps({k: headline[k] for k in
                       ("metric", "value", "unit", "vs_baseline")}))

@@ -345,8 +345,9 @@ class GGRSPlugin:
     def with_speculation(self, num_branches: int) -> "GGRSPlugin":
         """Precompute rollback recoveries with a ``num_branches``-wide
         speculative rollout each frame (P2P only; see
-        :mod:`bevy_ggrs_tpu.spec_runner`). 0/None disables."""
-        self.speculation = int(num_branches) or None
+        :mod:`bevy_ggrs_tpu.spec_runner`). Values <= 0 disable."""
+        n = int(num_branches)
+        self.speculation = n if n > 0 else None
         return self
 
     def build(self, app: Optional[RollbackApp] = None) -> RollbackApp:

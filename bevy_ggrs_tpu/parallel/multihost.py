@@ -1,0 +1,101 @@
+"""Multi-host scale-out: DCN process bootstrap + global mesh layout.
+
+The reference's "distributed backend" is peer-to-peer UDP between full
+replicas (survey §2.4) — kilobytes of inputs, no collectives. The
+TPU-native scale axis this framework adds (speculative branches, sharded
+entity worlds) runs on XLA collectives instead, and those must ride the
+right fabric:
+
+- **ICI** (inter-chip interconnect) links chips within one host/slice —
+  where the per-rollout traffic (branch-commit gather, entity-axis
+  all-gathers) belongs;
+- **DCN** (data-center network) links hosts — crossed only at process
+  bootstrap and for whatever axis you deliberately lay outermost.
+
+The layout rule (scaling-book recipe): order mesh axes so the
+highest-traffic axis maps to devices sharing ICI. :func:`global_branch_mesh`
+puts the branch axis outermost — contiguous branch blocks land on each
+host's local devices, so a rollout runs with ZERO cross-host traffic and
+only the confirmed-branch gather at commit time crosses DCN (once per
+rollback, a few KB of world state — the same order of traffic the
+reference's UDP replication pays per frame).
+
+Host-side session I/O stays replicated: every host runs the same session
+protocol over its own sockets (determinism keeps replicas consistent, the
+reference's own model), or one host runs the session and broadcasts inputs
+via :func:`jax.experimental.multihost_utils.broadcast_one_to_all`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from bevy_ggrs_tpu.parallel.sharding import branch_mesh
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> Tuple[int, int]:
+    """Bootstrap the JAX distributed runtime (DCN rendezvous) and return
+    ``(process_id, num_processes)``.
+
+    No-arg form reads the cluster environment (TPU pods auto-discover).
+    Single-process (tests, one host) is detected and skipped — safe to call
+    unconditionally at program start.
+    """
+    if num_processes is not None and num_processes <= 1:
+        return 0, 1
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (ValueError, RuntimeError):
+        # Already initialized (by a launcher or another library) or no
+        # cluster env: report whatever topology the runtime actually has.
+        return jax.process_index(), jax.process_count()
+    return jax.process_index(), jax.process_count()
+
+
+def global_branch_mesh(
+    entity_shards: int = 1,
+    branch_axis: str = "branch",
+    entity_axis: str = "entity",
+):
+    """A ``[branch, entity]`` mesh over ALL hosts' devices, branch axis
+    outermost so each host owns a contiguous branch block (rollouts stay
+    ICI/host-local; only commit crosses DCN)."""
+    return branch_mesh(
+        jax.devices(), entity_shards, branch_axis, entity_axis
+    )
+
+
+def local_branch_slice(num_branches: int) -> Tuple[int, int]:
+    """Which ``[start, stop)`` branch block this process feeds when the
+    branch axis is sharded over a :func:`global_branch_mesh`. Branch counts
+    must divide evenly (same constraint XLA imposes on the sharding)."""
+    n_proc = jax.process_count()
+    if num_branches % n_proc:
+        raise ValueError(
+            f"num_branches={num_branches} not divisible by "
+            f"process_count={n_proc}"
+        )
+    per = num_branches // n_proc
+    start = jax.process_index() * per
+    return start, start + per
+
+
+def process_topology() -> dict:
+    """Observability: this process's view of the cluster."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": [str(d) for d in jax.local_devices()],
+        "global_device_count": len(jax.devices()),
+    }

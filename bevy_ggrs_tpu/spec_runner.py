@@ -14,16 +14,25 @@ on a hit, recovery is a gather of that branch's precomputed ring/state —
 no resimulation on the critical path — and on a miss it falls back to the
 fused serial burst, bit-for-bit identical semantics either way.
 
-Speculation is semantically invisible: the states, ring contents, and
-reported checksums after a hit are exactly what the fallback would have
-produced, because a branch only commits when its input tensor matches the
-corrected inputs frame-for-frame (and as-used inputs from the anchor up to
-the load frame — the rollout started at the anchor, so its trajectory is
-only valid if every frame since matches). One constraint, documented and
-deliberate: game systems must not read ``PlayerInputs.status`` into state
-(speculative rollouts run all-PREDICTED; the reference gives systems the
-same visibility, so a status-dependent game would diverge under ANY
-prediction scheme — its own SyncTest would flag it).
+Speculation is semantically invisible when the model's step is
+*executable-stable*: a branch only commits when its input tensor matches
+the corrected inputs frame-for-frame (and the as-used inputs from the
+anchor up to the load frame — the rollout started at the anchor, so its
+trajectory is only valid if every frame since matches), so the committed
+states are the same *computation* the serial replay would run. The
+speculative rollout is, however, a different XLA executable (vmapped over
+branches) than the serial burst; per the determinism model
+(docs/determinism.md) the two agree bitwise only when XLA rounds the
+step's float ops identically under both layouts — true for box_game
+(verified on TPU), integer-state games, and fixed-order integer reductions
+generally, but not guaranteed for float-reduction models like boids. The
+periodic checksum exchange turns any violation into a detected desync
+rather than silent divergence; disable speculation for models that trip
+it. Two further constraints, documented and deliberate: game systems must
+not read ``PlayerInputs.status`` into state (speculative rollouts run
+all-PREDICTED; the reference gives systems the same visibility, so a
+status-dependent game would diverge under ANY prediction scheme — its own
+SyncTest would flag it).
 """
 
 from __future__ import annotations
@@ -222,9 +231,15 @@ class SpeculativeRollbackRunner(RollbackRunner):
         end = load_frame + n_steps  # frame entered after the burst
         if load_frame < anchor or end > anchor + res.num_frames:
             return False
-        # The standard recovery burst is save+advance every step; anything
-        # else (e.g. spectator-style advance-only) takes the generic path.
-        if any(s.adv is None or s.save_frame is None for s in steps):
+        # The standard recovery burst is save+advance every step with saves
+        # labeled contiguously from the load frame (the ggrs_stage.rs:277
+        # invariant); anything else (spectator-style advance-only, or a
+        # malformed burst) takes the generic path, where the serial runner
+        # enforces the invariant loudly.
+        if any(
+            s.adv is None or s.save_frame != load_frame + t
+            for t, s in enumerate(steps)
+        ):
             return False
         # Required input trajectory from the anchor: as-used inputs for
         # frames that survived the rollback, then the corrected inputs.

@@ -32,7 +32,7 @@ import numpy as np  # noqa: E402
 
 
 def build_app(num_players: int, max_prediction: int, fps: int, input_fn,
-              clock=None, speculation: int = 0):
+              clock=None, speculation: int = 0, metrics=None):
     from bevy_ggrs_tpu.app import GGRSPlugin
     from bevy_ggrs_tpu.models import box_game
     import jax.numpy as jnp
@@ -62,6 +62,8 @@ def build_app(num_players: int, max_prediction: int, fps: int, input_fn,
         plugin.with_clock(clock)
     if speculation:
         plugin.with_speculation(speculation)
+    if metrics is not None:
+        plugin.with_metrics(metrics)
     return plugin.build()
 
 
@@ -134,3 +136,48 @@ def add_common_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--fps", type=int, default=60)
     parser.add_argument("--platform", choices=["cpu", "tpu"], default="cpu",
                         help="JAX platform (cpu avoids the TPU claim)")
+    parser.add_argument("--profile", metavar="DIR", default=None,
+                        help="capture a JAX/XLA profiler trace of the run "
+                             "into DIR (view with TensorBoard)")
+    parser.add_argument("--report-metrics", action="store_true",
+                        help="collect per-phase timings + rollback-depth "
+                             "histograms and print the summary at exit")
+
+
+class Instruments:
+    """Wires --profile / --report-metrics into an app run.
+
+    Usage::
+
+        inst = Instruments(args)
+        app = build_app(..., metrics=inst.metrics)
+        with inst:
+            ... run loop ...
+        inst.finish()   # prints the metrics report when enabled
+    """
+
+    def __init__(self, args):
+        from bevy_ggrs_tpu.utils.metrics import Metrics
+
+        self.profile_dir = getattr(args, "profile", None)
+        self.metrics = Metrics() if getattr(args, "report_metrics", False) else None
+
+    def __enter__(self):
+        if self.profile_dir:
+            import jax
+
+            jax.profiler.start_trace(self.profile_dir)
+        return self
+
+    def __exit__(self, *exc):
+        if self.profile_dir:
+            import jax
+
+            jax.profiler.stop_trace()
+            print(f"[profile] trace written to {self.profile_dir}")
+        return False
+
+    def finish(self) -> None:
+        if self.metrics is not None:
+            print("[metrics]")
+            print(self.metrics.report())

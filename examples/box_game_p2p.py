@@ -21,6 +21,7 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from box_game_common import (  # noqa: E402
+    Instruments,
     add_common_args,
     build_app,
     force_platform,
@@ -78,8 +79,9 @@ def main() -> int:
 
     # Build (and JIT-compile) the app BEFORE binding the socket, so the
     # handshake starts only when we can actually service it.
+    inst = Instruments(args)
     app = build_app(num_players, args.max_prediction, args.fps, scripted_input,
-                    speculation=args.speculate)
+                    speculation=args.speculate, metrics=inst.metrics)
     socket = UdpSocket.bind_to_port(args.local_port)
     session = builder.start_p2p_session(socket)
     app.insert_session(session, SessionType.P2P)
@@ -87,12 +89,13 @@ def main() -> int:
     app.add_render_system(make_stats_system())
 
     dt = 1.0 / args.fps
-    for _ in range(args.frames):
-        t0 = time.monotonic()
-        app.update()
-        lead = dt - (time.monotonic() - t0)
-        if lead > 0:
-            time.sleep(lead)
+    with inst:
+        for _ in range(args.frames):
+            t0 = time.monotonic()
+            app.update()
+            lead = dt - (time.monotonic() - t0)
+            if lead > 0:
+                time.sleep(lead)
     extra = ""
     if args.speculate:
         extra = (f", spec_hits={app.stage.runner.spec_hits}"
@@ -102,6 +105,7 @@ def main() -> int:
                      f"(rollbacks={app.stage.runner.rollbacks_total}, "
                      f"resimulated={app.stage.runner.rollback_frames_total}"
                      f"{extra})")
+    inst.finish()
     return 0
 
 

@@ -18,6 +18,7 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from box_game_common import (  # noqa: E402
+    Instruments,
     add_common_args,
     build_app,
     force_platform,
@@ -43,7 +44,9 @@ def main() -> int:
     from bevy_ggrs_tpu.session import SessionBuilder
     from bevy_ggrs_tpu.transport.udp import UdpSocket
 
-    app = build_app(args.num_players, 8, args.fps, scripted_input)
+    inst = Instruments(args)
+    app = build_app(args.num_players, 8, args.fps, scripted_input,
+                    metrics=inst.metrics)
     socket = UdpSocket.bind_to_port(args.local_port)
     session = (
         SessionBuilder(box_game.INPUT_SPEC)
@@ -56,13 +59,15 @@ def main() -> int:
     app.add_render_system(make_stats_system())
 
     dt = 1.0 / args.fps
-    for _ in range(args.frames):
-        t0 = time.monotonic()
-        app.update()
-        lead = dt - (time.monotonic() - t0)
-        if lead > 0:
-            time.sleep(lead)
+    with inst:
+        for _ in range(args.frames):
+            t0 = time.monotonic()
+            app.update()
+            lead = dt - (time.monotonic() - t0)
+            if lead > 0:
+                time.sleep(lead)
     print_world(app, f"spectator done after {app.frame} sim frames")
+    inst.finish()
     return 0
 
 

@@ -18,6 +18,7 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from box_game_common import (  # noqa: E402
+    Instruments,
     add_common_args,
     build_app,
     force_platform,
@@ -45,16 +46,19 @@ def main() -> int:
         .with_max_prediction_window(max(8, args.check_distance))
         .start_synctest_session()
     )
+    inst = Instruments(args)
     app = build_app(args.num_players, max(8, args.check_distance), args.fps,
-                    scripted_input)
+                    scripted_input, metrics=inst.metrics)
     app.insert_session(session, SessionType.SYNC_TEST)
 
     try:
-        app.run_for(args.frames, dt=1.0 / args.fps)
+        with inst:
+            app.run_for(args.frames, dt=1.0 / args.fps)
     except MismatchedChecksum as exc:
         print(f"DESYNC: {exc}", file=sys.stderr)
         return 1
     print_world(app, f"synctest ok after {app.frame} frames")
+    inst.finish()
     return 0
 
 

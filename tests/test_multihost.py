@@ -1,0 +1,42 @@
+"""Multi-host helpers: single-process semantics on the 8-device CPU mesh.
+
+True multi-process DCN rendezvous needs multiple hosts; what CI pins down
+is the single-process contract every multi-host program degenerates to,
+plus the mesh/slice arithmetic that is pure logic.
+"""
+
+import jax
+import pytest
+
+from bevy_ggrs_tpu.parallel.multihost import (
+    global_branch_mesh,
+    initialize,
+    local_branch_slice,
+    process_topology,
+)
+
+
+def test_initialize_single_process_noop():
+    assert initialize(num_processes=1) == (0, 1)
+
+
+def test_global_branch_mesh_spans_all_devices():
+    mesh = global_branch_mesh(entity_shards=2)
+    assert mesh.devices.size == len(jax.devices()) == 8
+    assert mesh.axis_names == ("branch", "entity")
+    assert mesh.devices.shape == (4, 2)
+
+
+def test_local_branch_slice():
+    # Single process owns the whole branch range (divisibility failures
+    # need process_count > 1 and are covered by the arithmetic itself).
+    assert local_branch_slice(64) == (0, 64)
+    assert local_branch_slice(1) == (0, 1)
+
+
+def test_process_topology_keys():
+    topo = process_topology()
+    assert topo["process_index"] == 0
+    assert topo["process_count"] == 1
+    assert topo["global_device_count"] == 8
+    assert len(topo["local_devices"]) == 8
