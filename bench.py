@@ -227,9 +227,11 @@ def main() -> None:
         # Parent stays off the accelerator; every config (headline
         # included) measures in its own subprocess.
         detail = run_matrix()
-        headline = detail[0] if detail else None
+        headline = next(
+            (e for e in detail if e["metric"] == HEADLINE), None
+        )
         if headline is None:
-            raise SystemExit("bench: all configs failed")
+            raise SystemExit("bench: the headline config failed")
     else:
         platform = _ensure_backend()
         print(f"bench: running on {platform}", file=sys.stderr)

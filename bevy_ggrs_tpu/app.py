@@ -255,7 +255,7 @@ class GGRSStage:
         self.runner.handle_requests(requests, session)
         speculate = getattr(self.runner, "speculate", None)
         if speculate is not None:
-            speculate(session.confirmed_frame())
+            speculate(session.confirmed_frame(), session)
 
     def _step_spectator(self, app: RollbackApp) -> None:
         session: SpectatorSession = app.session

@@ -57,9 +57,18 @@ def initialize(
             process_id=process_id,
         )
     except (ValueError, RuntimeError):
-        # Already initialized (by a launcher or another library) or no
-        # cluster env: report whatever topology the runtime actually has.
-        return jax.process_index(), jax.process_count()
+        if jax.process_count() > 1:
+            # Already initialized by a launcher/another library: report the
+            # topology the runtime actually has.
+            return jax.process_index(), jax.process_count()
+        if coordinator_address is not None or (
+            num_processes is not None and num_processes > 1
+        ):
+            # Multi-process was explicitly requested but the runtime ended
+            # up single-process: a silent (0, 1) here would degenerate the
+            # job into N disconnected replicas with no error at the cause.
+            raise
+        return 0, 1
     return jax.process_index(), jax.process_count()
 
 

@@ -149,6 +149,13 @@ class P2PSession:
             [h not in self._disconnected for h in range(self.num_players)]
         )
 
+    def confirmed_input(self, handle: int, frame: int):
+        """The confirmed input of ``handle`` for ``frame``, or None while it
+        is still a prediction. The speculative runner pins these known
+        values across every candidate branch so branch capacity is spent
+        exclusively on genuinely unknown inputs."""
+        return self._queues[handle].confirmed(frame)
+
     def frames_ahead(self) -> int:
         """How many frames we should yield to let slower peers catch up
         (>0 ⇒ the driver runs ×1.1 slower, `ggrs_stage.rs:107-109,227`).

@@ -99,6 +99,7 @@ def main() -> int:
     extra = ""
     if args.speculate:
         extra = (f", spec_hits={app.stage.runner.spec_hits}"
+                 f", spec_partial={app.stage.runner.spec_partial_hits}"
                  f", spec_misses={app.stage.runner.spec_misses}"
                  f", recovered={app.stage.runner.rollback_frames_recovered_total}")
     print_world(app, f"p2p done after {app.frame} sim frames "

@@ -169,6 +169,48 @@ def test_hit_through_rollout_end_uses_final_state():
     assert spec.spec_hits == 1
 
 
+def test_partial_prefix_commit_resimulates_only_tail():
+    # Branch matches the first 2 of 3 corrected frames: those 2 commit from
+    # the rollout, only the third is resimulated — still bitwise equal.
+    corrected = [[11, 1], [12, 2], [13, 3]]
+    tensor = np.zeros((2, 4, P), np.uint8)
+    tensor[1, 0] = corrected[0]
+    tensor[1, 1] = corrected[1]
+    tensor[1, 2] = [99, 99]  # diverges at the third replayed frame
+    serial, spec = make_runners(fixed_sampler(tensor), 2, 4)
+    script = [("reqs", step_requests(f, [f, f + 1])) for f in range(3)]
+    script.append(("speculate", 2))  # anchor = 3
+    script.append(("reqs", step_requests(3, [3, 4])))
+    script.append(("reqs", step_requests(4, [4, 5])))
+    script.append(("reqs", rollback_requests(3, corrected)))
+    run_both(serial, spec, script)
+    assert spec.spec_partial_hits == 1 and spec.spec_hits == 0
+    assert spec.rollback_frames_recovered_total == 2
+    assert spec.rollback_frames_total == 1  # only the tail frame re-ran
+
+
+def test_sampler_path_with_session_pinning():
+    """Custom sampler + a session exposing confirmed_input: pinning must
+    produce a writable tensor (regression: read-only device-array view)
+    and pinned slots must override the sampler across all branches."""
+    class FakeSession:
+        def confirmed_input(self, handle, frame):
+            if frame <= 4:  # frames 3..4 confirmed for everyone
+                return np.uint8(7 + handle)
+            return None
+
+    tensor = np.full((4, 4, P), 13, np.uint8)
+    _, spec = make_runners(fixed_sampler(tensor), 4, 4)
+    script = [("reqs", step_requests(f, [f, f + 1])) for f in range(3)]
+    for item in script:
+        spec.handle_requests(item[1], ChecksumLog())
+    spec.speculate(2, FakeSession())  # anchor 3, span 3..6
+    bits = np.asarray(spec._result.branch_bits)
+    assert (bits[:, 0] == [7, 8]).all()  # frame 3 pinned
+    assert (bits[:, 1] == [7, 8]).all()  # frame 4 pinned
+    assert (bits[:, 2] == 13).all()  # frame 5 from the sampler
+
+
 def test_loopback_session_equivalence():
     """Full P2P run: peer 0 speculating must produce exactly the checksum
     stream of the all-serial universe (hits or not)."""
