@@ -113,9 +113,27 @@ def _boids_case(num_boids: int, players: int, frames: int, branches: int,
     return ex, state, jax.block_until_ready(bits)
 
 
+def _host_device_rtt_ms() -> float:
+    """One dispatch+sync round trip for a scalar — the infrastructure noise
+    floor. The remote-TPU tunnel is bimodal (sub-ms normally, ~100 ms in
+    degraded windows); recording it per process makes latency entries
+    interpretable: value ≈ rtt means the measurement is tunnel-bound, not
+    compute-bound (sustained_ms pipelines dispatches and stays meaningful
+    either way)."""
+    import jax.numpy as jnp
+
+    jax.block_until_ready(jnp.asarray(1, jnp.int32) + 1)
+    times = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        jax.block_until_ready(jnp.asarray(0, jnp.int32) + 1)
+        times.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(times))
+
+
 def _entry(metric: str, ms: float, sustained: float, frames: int,
-           branches: int) -> dict:
-    return {
+           branches: int, **extra) -> dict:
+    out = {
         "metric": metric,
         "value": round(ms, 3),
         "unit": "ms",
@@ -124,10 +142,77 @@ def _entry(metric: str, ms: float, sustained: float, frames: int,
         "frames": frames,
         "branches": branches,
         "platform": jax.devices()[0].platform,
+        "host_device_rtt_ms": round(_host_device_rtt_ms(), 3),
         "rollback_frames_per_sec": round(frames * branches / (ms / 1000.0)),
         "sustained_rollback_frames_per_sec": round(
             frames * branches / (sustained / 1000.0)),
     }
+    out.update(extra)
+    return out
+
+
+def _recovery_case(model: str, frames: int, branches: int):
+    """Misprediction-recovery latency, the BASELINE.md north-star metric:
+    serial = the fused Load+resimulate burst every rollback pays without
+    speculation; spec = committing a precomputed matching branch
+    (gather + ring absorb) as the SpeculativeRollbackRunner does on a hit."""
+    import jax.numpy as jnp
+    from bevy_ggrs_tpu.models import boids, box_game
+    from bevy_ggrs_tpu.parallel.speculate import SpeculativeExecutor
+    from bevy_ggrs_tpu.rollout import RolloutExecutor
+    from bevy_ggrs_tpu.spec_runner import _absorb
+    from bevy_ggrs_tpu.state import ring_init, ring_save
+
+    if model == "boids":
+        schedule = boids.make_schedule(use_pallas=True)
+        state = boids.make_world(1024, 2).commit()
+    else:
+        schedule = box_game.make_schedule()
+        state = box_game.make_world(2).commit()
+    rng = np.random.RandomState(0)
+    host_bits = rng.randint(0, 16, (branches, frames, 2), dtype=np.uint8)
+    bits = jnp.asarray(host_bits)
+    status = np.zeros((frames, 2), np.int32)
+
+    ex = SpeculativeExecutor(schedule, branches, frames)
+    res = ex.run(state, 0, bits)
+    jax.block_until_ready((res.rings, res.states, res.checksums))
+
+    serial = RolloutExecutor(schedule, frames)
+    ring = ring_init(state, frames)
+    ring, _ = ring_save(ring, state, 0)
+    replay_bits = host_bits[3]  # host copy: no d2h slice in the timed loop
+
+    def serial_recovery():
+        out = serial.run(ring, state, 0, replay_bits, status,
+                         n_frames=frames, load_frame=0)
+        jax.block_until_ready(out)
+
+    def spec_recovery():
+        spec_ring, spec_state = ex.commit(res, 3)
+        out = _absorb(ring, spec_ring, spec_state,
+                      jnp.asarray(0, jnp.int32), jnp.asarray(frames, jnp.int32),
+                      jnp.asarray(0, jnp.int32), jnp.asarray(frames, jnp.int32),
+                      max_steps=frames)
+        jax.block_until_ready(out)
+
+    def med(fn, iters=20):
+        fn()
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            fn()
+            times.append((time.perf_counter() - t0) * 1000.0)
+        return float(np.median(times))
+
+    serial_ms = med(serial_recovery)
+    spec_ms = med(spec_recovery)
+    return _entry(
+        f"{model}_recovery_{frames}f_spec_vs_serial", spec_ms, spec_ms,
+        frames, 1,
+        serial_resim_ms=round(serial_ms, 3),
+        spec_commit_speedup=round(serial_ms / spec_ms, 2),
+    )
 
 
 def run_headline() -> dict:
@@ -156,8 +241,18 @@ _CONFIGS = {
     "box_game_8p_12f_x_1024b": (lambda: _box_game_case(8, 12, 1024), 12, 1024),
 }
 
+# North-star recovery-latency comparisons (speculative commit vs serial
+# resimulation for a full-depth rollback); run as matrix configs too.
+_RECOVERY_CONFIGS = {
+    "box_game_recovery_8f_spec_vs_serial": ("box_game", 8, 32),
+    "boids_recovery_8f_spec_vs_serial": ("boids", 8, 32),
+}
+
 
 def run_config(name: str) -> dict:
+    if name in _RECOVERY_CONFIGS:
+        model, frames, branches = _RECOVERY_CONFIGS[name]
+        return _recovery_case(model, frames, branches)
     case, frames, branches = _CONFIGS[name]
     ex, state, bits = case()
     ms, sustained = _time_rollout(ex, state, bits)
@@ -172,7 +267,7 @@ def run_matrix() -> list:
 
     detail = []
     platform = None
-    for name in _CONFIGS:
+    for name in list(_CONFIGS) + list(_RECOVERY_CONFIGS):
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--config", name],
             capture_output=True, text=True, cwd=os.path.dirname(
@@ -214,8 +309,9 @@ def main() -> None:
     args = sys.argv[1:]
     if "--config" in args:
         idx = args.index("--config") + 1
-        if idx >= len(args) or args[idx] not in _CONFIGS:
-            print(f"bench: --config needs one of: {', '.join(_CONFIGS)}",
+        valid = list(_CONFIGS) + list(_RECOVERY_CONFIGS)
+        if idx >= len(args) or args[idx] not in valid:
+            print(f"bench: --config needs one of: {', '.join(valid)}",
                   file=sys.stderr)
             raise SystemExit(2)
         platform = _ensure_backend()

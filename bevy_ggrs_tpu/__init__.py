@@ -35,4 +35,14 @@ from bevy_ggrs_tpu.state import (
     to_host,
 )
 
+# Heavier layers import on demand to keep `import bevy_ggrs_tpu` light:
+#   bevy_ggrs_tpu.app          — GGRSPlugin / RollbackApp / GGRSStage
+#   bevy_ggrs_tpu.runner       — RollbackRunner (request-burst executor)
+#   bevy_ggrs_tpu.spec_runner  — SpeculativeRollbackRunner (recovery-as-select)
+#   bevy_ggrs_tpu.session      — P2P / SyncTest / Spectator + builder
+#   bevy_ggrs_tpu.transport    — UDP + deterministic loopback
+#   bevy_ggrs_tpu.parallel     — branch/entity sharding, multihost, executor
+#   bevy_ggrs_tpu.ops          — Pallas TPU kernels (checksum, pairwise)
+#   bevy_ggrs_tpu.utils        — metrics, persistence (checkpoint/resume)
+
 __version__ = "0.1.0"

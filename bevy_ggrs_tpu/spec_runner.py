@@ -230,14 +230,13 @@ class SpeculativeRollbackRunner(RollbackRunner):
                 sub, jnp.asarray(last), self.num_branches, self.spec_frames,
                 sampler=self._sampler,
             )
-            if known_mask.any():  # pin known values across all branches
-                # (host round-trip only when there is something to pin —
-                # otherwise bits stays on device and dispatch stays async)
-                bits = np.array(bits)  # writable host copy
-                bits[:, known_mask] = np.broadcast_to(
-                    known[known_mask], (self.num_branches,) +
-                    known[known_mask].shape,
+            if known_mask.any():  # pin known values across all branches,
+                # on device — speculate() stays fully asynchronous
+                extra = bits.ndim - 3  # input payload dims beyond [B, F, P]
+                mask_b = jnp.asarray(known_mask).reshape(
+                    (1,) + known_mask.shape + (1,) * extra
                 )
+                bits = jnp.where(mask_b, jnp.asarray(known)[None], bits)
         else:
             bits = self._structured_bits(np.asarray(last), known, known_mask)
         # anchor == self.frame: the current live state IS the anchor state
@@ -278,8 +277,17 @@ class SpeculativeRollbackRunner(RollbackRunner):
         held). Earlier change frames enumerate first: the first incorrect
         frame is usually near the confirmed frontier."""
         F, P, B = self.spec_frames, self.num_players, self.num_branches
-        base = np.broadcast_to(last, (F, P)).copy()
-        base[known_mask] = known[known_mask]
+        # Base = the session's actual prediction: per player, forward-fill
+        # the latest known value (a confirmed change inside the span keeps
+        # predicting the NEW value afterwards, exactly like the repeat-last
+        # queues) — resuming the anchor-1 input after a pinned prefix would
+        # make branch 0 diverge from the session's prediction and force
+        # two-change branches the tree never enumerates.
+        base = np.empty((F, P), dtype=last.dtype)
+        carry = last.copy()
+        for t in range(F):
+            carry = np.where(known_mask[t], known[t], carry)
+            base[t] = carry
         out = np.broadcast_to(base, (B, F, P)).copy()
         b = 1
         frames_idx = np.arange(F)

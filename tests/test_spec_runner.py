@@ -211,6 +211,26 @@ def test_sampler_path_with_session_pinning():
     assert (bits[:, 2] == 13).all()  # frame 5 from the sampler
 
 
+def test_structured_base_forward_fills_known_changes():
+    """A confirmed input change inside the span must carry forward into the
+    unknown suffix (the session predicts repeat-LAST-CONFIRMED, not
+    repeat-anchor-input) — otherwise branch 0 diverges from the session's
+    own prediction."""
+    _, spec = make_runners(None, num_branches=8, spec_frames=4)
+    last = np.array([1, 2], np.uint8)
+    known = np.zeros((4, P), np.uint8)
+    known_mask = np.zeros((4, P), bool)
+    known[0, 0] = 9  # player 0 confirmed a change to 9 at span frame 0
+    known_mask[0, 0] = True
+    bits = spec._structured_bits(last, known, known_mask)
+    # Branch 0: player 0 holds the NEW confirmed value through the suffix;
+    # player 1 repeats its anchor input.
+    assert bits[0, :, 0].tolist() == [9, 9, 9, 9]
+    assert bits[0, :, 1].tolist() == [2, 2, 2, 2]
+    # Change branches never alter the pinned slot.
+    assert (bits[:, 0, 0] == 9).all()
+
+
 def test_loopback_session_equivalence():
     """Full P2P run: peer 0 speculating must produce exactly the checksum
     stream of the all-serial universe (hits or not)."""
