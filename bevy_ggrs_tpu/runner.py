@@ -159,10 +159,21 @@ class RollbackRunner:
                     adv_mask=adv_mask,
                 )
             if session is not None and self.report_checksums and save_mask.any():
-                with self.metrics.timer("checksum_sync"):
-                    cs_host = np.asarray(checksums)
-                for t, sf in enumerate(save_frames):
-                    if sf is not None:
+                # Only frames the session actually wants force the
+                # device->host sync: SyncTest compares every frame, but P2P
+                # exchanges only every CHECKSUM_SEND_INTERVAL-th confirmed
+                # frame — most bursts then complete without any host sync,
+                # which matters when the host-device round trip is the
+                # latency floor (remote-TPU tunnels).
+                wants = getattr(session, "wants_checksum", None)
+                report = [
+                    (t, sf) for t, sf in enumerate(save_frames)
+                    if sf is not None and (wants is None or wants(sf))
+                ]
+                if report:
+                    with self.metrics.timer("checksum_sync"):
+                        cs_host = np.asarray(checksums)
+                    for t, sf in report:
                         session.report_checksum(sf, int(cs_host[t]))
         self.metrics.count("frames_advanced", sum(1 for s in steps if s.adv))
         if load_frame is not None:

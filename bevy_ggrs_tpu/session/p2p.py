@@ -320,6 +320,12 @@ class P2PSession:
     # ------------------------------------------------------------------
     # Checksums / desync detection
 
+    def wants_checksum(self, frame: int) -> bool:
+        """Only exchange-interval frames are worth the device->host sync a
+        checksum report costs (see RollbackRunner); desync detection
+        compares exactly these."""
+        return frame % CHECKSUM_SEND_INTERVAL == 0
+
     def report_checksum(self, frame: int, checksum: int) -> None:
         """Driver reports each saved frame's checksum (the
         ``GameStateCell::save`` analog). Resimulated frames overwrite —

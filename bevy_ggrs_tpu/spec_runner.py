@@ -362,9 +362,15 @@ class SpeculativeRollbackRunner(RollbackRunner):
                 max_steps=self.executor.max_frames,
             )
         if session is not None and self.report_checksums:
-            cs_host = np.asarray(checksums)
-            for t in range(n_commit):
-                session.report_checksum(load_frame + t, int(cs_host[t]))
+            wants = getattr(session, "wants_checksum", None)
+            report = [
+                t for t in range(n_commit)
+                if wants is None or wants(load_frame + t)
+            ]
+            if report:
+                cs_host = np.asarray(checksums)
+                for t in report:
+                    session.report_checksum(load_frame + t, int(cs_host[t]))
         for t, s in enumerate(steps[:n_commit]):
             self._input_log[load_frame + t] = np.asarray(s.adv.bits)
         self.frame = load_frame + n_commit

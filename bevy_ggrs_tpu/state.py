@@ -214,12 +214,17 @@ class HostWorld:
         )
 
     def commit(self) -> WorldState:
+        # jnp.array (copying), NOT jnp.asarray: on CPU the latter can
+        # zero-copy the staging buffers, aliasing the "immutable" committed
+        # state to this world — a later spawn/despawn would then silently
+        # mutate already-committed snapshots (alignment-dependent, so it
+        # bites intermittently).
         return WorldState(
-            alive=jnp.asarray(self._alive),
-            rollback_id=jnp.asarray(self._rollback_id),
-            components={n: jnp.asarray(a) for n, a in self._components.items()},
-            present={n: jnp.asarray(a) for n, a in self._present.items()},
-            resources=jax.tree_util.tree_map(jnp.asarray, self._resources),
+            alive=jnp.array(self._alive),
+            rollback_id=jnp.array(self._rollback_id),
+            components={n: jnp.array(a) for n, a in self._components.items()},
+            present={n: jnp.array(a) for n, a in self._present.items()},
+            resources=jax.tree_util.tree_map(jnp.array, self._resources),
         )
 
 

@@ -120,7 +120,7 @@ class TestP2PBasic:
         # Constant inputs: repeat-last prediction is always right.
         drive(net, peers, lambda h, f: np.uint8(box_game.INPUT_UP), 40)
         frames, pairs = common_confirmed_checksums(peers)
-        assert len(frames) >= 20
+        assert len(frames) >= 2  # exchange-interval frames only (lazy reporting)
         assert all(a == b for a, b in pairs)
 
     def test_latency_forces_rollbacks_and_peers_agree(self):
@@ -130,7 +130,7 @@ class TestP2PBasic:
         (sa, ra), (sb, rb) = peers
         assert ra.rollbacks_total > 0 and rb.rollbacks_total > 0
         frames, pairs = common_confirmed_checksums(peers)
-        assert len(frames) >= 40, "peers barely confirmed any frames"
+        assert len(frames) >= 4, "peers barely confirmed any frames"
         assert all(a == b for a, b in pairs), "desync between peers"
 
     def test_packet_loss_and_jitter_still_consistent(self):
@@ -139,7 +139,7 @@ class TestP2PBasic:
         events = []
         drive(net, peers, scripted_input, 120, collect_events=events)
         frames, pairs = common_confirmed_checksums(peers)
-        assert len(frames) >= 30
+        assert len(frames) >= 4
         assert all(a == b for a, b in pairs)
         assert not any(e.kind == EventKind.DESYNC_DETECTED for e in events)
 

@@ -73,7 +73,7 @@ class TestThreePlayers:
         base = sessions[0]._local_checksums
         for s in sessions[1:]:
             common = [f for f in base if f <= upto and f in s._local_checksums]
-            assert len(common) > 15
+            assert len(common) >= 2  # exchange-interval frames only
             assert all(base[f] == s._local_checksums[f] for f in common)
 
     def test_survivors_converge_after_disconnect(self):
@@ -106,7 +106,7 @@ class TestThreePlayers:
             f for f in sa._local_checksums
             if f <= upto and f in sb._local_checksums
         ]
-        assert len(common) > 20
+        assert len(common) >= 3
         mismatches = [f for f in common if sa._local_checksums[f] != sb._local_checksums[f]]
         assert not mismatches, f"survivors desynced at frames {mismatches}"
         # ...and no desync event fired on a healthy (post-C) match.
