@@ -217,15 +217,19 @@ def _recovery_case(model: str, frames: int, branches: int):
     )
 
 
+def _bracketed(fn):
+    """Run ``fn`` with RTT probes on BOTH sides (the tunnel is bimodal over
+    minutes; a probe from a different window than the measurement would
+    misclassify tunnel-bound vs compute-bound); returns (result, worse
+    rtt)."""
+    rtt0 = _host_device_rtt_ms()
+    result = fn()
+    return result, max(rtt0, _host_device_rtt_ms())
+
+
 def run_headline() -> dict:
     ex, state, bits = _box_game_case(players=2, frames=8, branches=256)
-    # Probe the tunnel round trip on BOTH sides of the timed loop (the
-    # tunnel is bimodal over minutes; a probe from a different window than
-    # the measurement would misclassify tunnel-bound vs compute-bound) and
-    # record the worse one.
-    rtt0 = _host_device_rtt_ms()
-    ms, sustained = _time_rollout(ex, state, bits)
-    rtt = max(rtt0, _host_device_rtt_ms())
+    (ms, sustained), rtt = _bracketed(lambda: _time_rollout(ex, state, bits))
     return _entry(HEADLINE, ms, sustained, 8, 256, rtt_ms=rtt)
 
 
@@ -260,17 +264,14 @@ _RECOVERY_CONFIGS = {
 def run_config(name: str) -> dict:
     if name in _RECOVERY_CONFIGS:
         model, frames, branches = _RECOVERY_CONFIGS[name]
-        rtt0 = _host_device_rtt_ms()
-        entry = _recovery_case(model, frames, branches)
-        entry["host_device_rtt_ms"] = round(
-            max(rtt0, entry["host_device_rtt_ms"]), 3
+        entry, rtt = _bracketed(
+            lambda: _recovery_case(model, frames, branches)
         )
+        entry["host_device_rtt_ms"] = round(rtt, 3)
         return entry
     case, frames, branches = _CONFIGS[name]
     ex, state, bits = case()
-    rtt0 = _host_device_rtt_ms()
-    ms, sustained = _time_rollout(ex, state, bits)
-    rtt = max(rtt0, _host_device_rtt_ms())
+    (ms, sustained), rtt = _bracketed(lambda: _time_rollout(ex, state, bits))
     return _entry(name, ms, sustained, frames, branches, rtt_ms=rtt)
 
 

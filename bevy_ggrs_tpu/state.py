@@ -76,7 +76,9 @@ class ResourceDef:
     initial: Any = None
 
     def prototype(self) -> Any:
-        return jax.tree_util.tree_map(jnp.asarray, self.initial)
+        # jnp.array (copying): jnp.asarray can zero-copy a host buffer the
+        # caller still owns — see HostWorld.commit.
+        return jax.tree_util.tree_map(jnp.array, self.initial)
 
 
 class TypeRegistry:
@@ -210,7 +212,7 @@ class HostWorld:
             raise KeyError(f"resource {name!r} not registered")
         proto = self._resources[name]
         self._resources[name] = jax.tree_util.tree_map(
-            lambda p, v: jnp.asarray(v, dtype=p.dtype), proto, value
+            lambda p, v: jnp.array(v, dtype=p.dtype), proto, value
         )
 
     def commit(self) -> WorldState:
