@@ -209,9 +209,11 @@ def _recovery_case(model: str, frames: int, branches: int):
 
     serial_ms = med(serial_recovery)
     spec_ms = med(spec_recovery)
+    # rtt_ms placeholder: run_config overwrites with its bracketed probe
+    # (probing here too would waste ~10 blocking round trips per config).
     return _entry(
         f"{model}_recovery_{frames}f_spec_vs_serial", spec_ms, spec_ms,
-        frames, 1,
+        frames, 1, rtt_ms=-1.0,
         serial_resim_ms=round(serial_ms, 3),
         spec_commit_speedup=round(serial_ms / spec_ms, 2),
     )

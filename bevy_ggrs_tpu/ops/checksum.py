@@ -120,7 +120,7 @@ def checksum_pallas(state: WorldState) -> jnp.ndarray:
     words_t = _word_matrix(state)
     alive = state.alive.astype(jnp.uint32)[None, :]
     total = _entity_hash_sum(words_t, alive, interpret=_use_interpret())
-    return total + state_lib._resources_checksum(state)
+    return total + state_lib._resources_checksum(state.resources)
 
 
 def install_pallas_checksum(enable: bool = True) -> None:

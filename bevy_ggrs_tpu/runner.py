@@ -204,3 +204,22 @@ class RollbackRunner:
         """Host copy of the current world (the confirmed-state scatter-back
         boundary — the only place non-rollback code should read from)."""
         return to_host(self.state)
+
+    def diagnose_frame(self, frame: int):
+        """Per-component checksum breakdown of the snapshot saved for
+        ``frame`` (None if its ring slot was overwritten). On a
+        DESYNC_DETECTED event, both peers call this for the divergent frame
+        and diff the dicts to localize which registered type diverged.
+
+        Note: checksums exchange every 16th confirmed frame, while the ring
+        holds only ``max_prediction + 1`` frames — by detection time the
+        exact divergent frame has usually rotated out. Divergence persists
+        (it is non-determinism, not a glitch), so diagnosing the CURRENT
+        state (``checksum_breakdown(runner.state)`` on both peers) localizes
+        it just as well."""
+        from bevy_ggrs_tpu.state import checksum_breakdown, ring_frame_at, ring_load
+
+        # frame < 0 would collide with the ring's -1 empty-slot sentinel.
+        if frame < 0 or ring_frame_at(self.ring, frame) != frame:
+            return None
+        return checksum_breakdown(ring_load(self.ring, frame))

@@ -328,15 +328,15 @@ def checksum(state: WorldState) -> jnp.ndarray:
         h = _mix_words(h, words)
     h = _fmix(h)
     total = jnp.sum(jnp.where(state.alive, h, jnp.uint32(0)), dtype=jnp.uint32)
-    return total + _resources_checksum(state)
+    return total + _resources_checksum(state.resources)
 
 
-def _resources_checksum(state: WorldState) -> jnp.ndarray:
+def _resources_checksum(resources: Dict[str, Any]) -> jnp.ndarray:
     """Order-sensitive resource hash stream, keyed by sorted name for
     stability; shared by the XLA and Pallas checksum paths."""
     total = jnp.uint32(0)
-    for name in sorted(state.resources):
-        leaves = jax.tree_util.tree_leaves(state.resources[name])
+    for name in sorted(resources):
+        leaves = jax.tree_util.tree_leaves(resources[name])
         # Seed with the full name so same-length-named resources can't swap
         # values undetected.
         name_seed = 0
@@ -348,6 +348,43 @@ def _resources_checksum(state: WorldState) -> jnp.ndarray:
             rh = _mix_words(rh, words)
         total = total + _fmix(rh)[0]
     return total
+
+
+def checksum_breakdown(state: WorldState) -> Dict[str, int]:
+    """Per-part checksums for desync diagnosis.
+
+    The session's desync detection (survey §5: checksum exchange) says THAT
+    peers diverged; this says WHERE — which registered component or
+    resource holds different bits. Each part is hashed independently
+    (order-insensitive over live slots, like :func:`checksum`), so two
+    peers can diff their breakdowns for the divergent frame and localize
+    the first non-deterministic system. Host-side tool; not part of the
+    per-frame hot path.
+    """
+    cap = state.capacity
+    out: Dict[str, int] = {}
+
+    def slot_sum(h):
+        h = _fmix(h)
+        return int(jnp.sum(jnp.where(state.alive, h, jnp.uint32(0)),
+                           dtype=jnp.uint32))
+
+    h = jnp.full((cap,), _SEED, dtype=jnp.uint32)
+    out["rollback_id"] = slot_sum(_mix_words(h, _to_u32_words(state.rollback_id)))
+    out["alive"] = slot_sum(
+        _mix_words(h, state.alive.astype(jnp.uint32).reshape(cap, 1))
+    )
+    for name in sorted(state.components):
+        words = _to_u32_words(state.components[name])
+        pres = state.present[name]
+        words = jnp.where(pres[:, None], words, jnp.uint32(0))
+        hh = _mix_words(h, pres.astype(jnp.uint32).reshape(cap, 1))
+        out[f"component/{name}"] = slot_sum(_mix_words(hh, words))
+    for name in sorted(state.resources):
+        out[f"resource/{name}"] = int(
+            _resources_checksum({name: state.resources[name]})
+        )
+    return out
 
 
 # Pluggable checksum implementation for ring_save. The Pallas kernel

@@ -6,17 +6,22 @@ the ``JAX_PLATFORMS`` env var. So the env var alone is not enough: we must
 (a) inject the virtual-device XLA flag before any backend initializes, and
 (b) re-update the config back to cpu. Tests then never touch the TPU tunnel
 and get a deterministic 8-device mesh for sharding coverage.
+
+Set ``GGRS_TEST_TPU=1`` to run the suite against the real default backend
+instead (Pallas kernels then execute compiled rather than interpreted;
+multi-device sharding tests will skip if only one chip is visible).
 """
 
 import os
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+if os.environ.get("GGRS_TEST_TPU") != "1":
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
-import jax
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")

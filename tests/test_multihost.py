@@ -21,6 +21,8 @@ def test_initialize_single_process_noop():
 
 
 def test_global_branch_mesh_spans_all_devices():
+    if len(jax.devices()) != 8:
+        pytest.skip("needs the 8-device mesh (GGRS_TEST_TPU run on <8 chips)")
     mesh = global_branch_mesh(entity_shards=2)
     assert mesh.devices.size == len(jax.devices()) == 8
     assert mesh.axis_names == ("branch", "entity")
@@ -38,5 +40,5 @@ def test_process_topology_keys():
     topo = process_topology()
     assert topo["process_index"] == 0
     assert topo["process_count"] == 1
-    assert topo["global_device_count"] == 8
-    assert len(topo["local_devices"]) == 8
+    assert topo["global_device_count"] == len(jax.devices())
+    assert len(topo["local_devices"]) == len(jax.local_devices())

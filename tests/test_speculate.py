@@ -137,7 +137,9 @@ class TestSharded:
     def test_sharded_equals_unsharded(self):
         schedule, state, _ = setup()
         n_dev = len(jax.devices())
-        assert n_dev == 8, "conftest should provide 8 virtual devices"
+        if n_dev < 2:
+            pytest.skip("sharding test needs >1 device "
+                        "(GGRS_TEST_TPU run on one chip)")
         mesh = branch_mesh()
         bb = 2 * n_dev
         rng = np.random.RandomState(11)
@@ -159,6 +161,8 @@ class TestSharded:
 
     def test_sharded_commit_gathers(self):
         schedule, state, _ = setup()
+        if len(jax.devices()) < 2:
+            pytest.skip("sharding test needs >1 device")
         mesh = branch_mesh()
         bb = 16
         rng = np.random.RandomState(12)

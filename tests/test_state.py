@@ -207,3 +207,56 @@ def test_empty_registry_state():
     state = init_state(reg, 4)
     assert int(state.num_alive()) == 0
     int(checksum(state))  # must not crash on empty component/resource dicts
+
+
+def test_checksum_breakdown_localizes_divergence():
+    from bevy_ggrs_tpu.state import checksum_breakdown
+
+    reg = make_registry()
+    state = make_world(reg).commit()
+    base = checksum_breakdown(state)
+    assert set(k.split("/")[0] for k in base) >= {"component", "rollback_id", "alive"}
+
+    # Mutate exactly one component: only its entry may change.
+    name = sorted(state.components)[0]
+    mutated = state.replace(
+        components={
+            **state.components,
+            name: state.components[name] + jnp.ones_like(state.components[name]),
+        }
+    )
+    mb = checksum_breakdown(mutated)
+    diff = {k for k in base if mb[k] != base[k]}
+    assert diff == {f"component/{name}"}
+
+    # Mutate a resource: only that resource entry changes.
+    rname = sorted(state.resources)[0]
+    bumped = state.replace(
+        resources={
+            **state.resources,
+            rname: jax.tree_util.tree_map(lambda x: x + 1, state.resources[rname]),
+        }
+    )
+    bb = checksum_breakdown(bumped)
+    diff_r = {k for k in base if bb[k] != base[k]}
+    assert diff_r == {f"resource/{rname}"}
+
+
+def test_runner_diagnose_frame():
+    from bevy_ggrs_tpu.models import box_game
+    from bevy_ggrs_tpu.runner import RollbackRunner
+    from bevy_ggrs_tpu.session import SyncTestSession
+
+    session = SyncTestSession(2, box_game.INPUT_SPEC, check_distance=2,
+                              max_prediction=4)
+    runner = RollbackRunner(box_game.make_schedule(),
+                            box_game.make_world(2).commit(),
+                            max_prediction=4, num_players=2,
+                            input_spec=box_game.INPUT_SPEC)
+    for i in range(6):
+        for h in range(2):
+            session.add_local_input(h, np.uint8(i % 4))
+        runner.handle_requests(session.advance_frame(), session)
+    d = runner.diagnose_frame(runner.frame - 1)
+    assert d is not None and "component/translation" in d
+    assert runner.diagnose_frame(runner.frame - 100) is None
