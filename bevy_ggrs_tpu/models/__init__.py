@@ -1,5 +1,11 @@
 """Example game models (the reference's ``examples/`` analog): each model
 provides a registry, a setup/spawn routine, and a rollback schedule of pure
-systems."""
+systems.
 
-from bevy_ggrs_tpu.models import box_game
+- ``box_game`` — reference-parity example (per-entity arithmetic)
+- ``boids`` — entity-coupled O(N²) flocking (VPU / Pallas showcase)
+- ``neural_bots`` — MLP-policy agents (MXU showcase: batched inference
+  inside the rollback domain, weights as rollback state)
+"""
+
+from bevy_ggrs_tpu.models import boids, box_game, neural_bots

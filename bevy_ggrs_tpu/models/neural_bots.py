@@ -1,0 +1,200 @@
+"""Neural bots: MLP-policy agents — the MXU-workload model family.
+
+box_game (`/root/reference/examples/box_game/box_game.rs`) exercises
+per-entity arithmetic; boids exercises entity coupling on the VPU. This
+third family puts the MXU inside the rollback domain: every bot steers via
+a shared small MLP policy evaluated as batched matmuls each simulated
+frame — the shape of games with learned NPCs/bots, where rollback
+netcode must replay *network inference* deterministically.
+
+Design points:
+
+- The policy weights are a registered rollback RESOURCE: they are part of
+  game state (a mid-match weight update — e.g. difficulty scaling — rolls
+  back like anything else), and they are hashed into the world checksum.
+- Inference is ``obs[N, OBS] @ W1[OBS, H] -> tanh -> @ W2[H, 4]`` over all
+  capacity slots at once — static shapes, batched, exactly what the MXU
+  tiles; with B speculative branches vmapped on top it becomes
+  ``[B, N, OBS] x [OBS, H]``.
+- Player inputs steer per-player "leader" targets the bots pursue, so the
+  full session machinery (prediction, rollback, checksums, speculation)
+  applies unchanged with the same u8 bitmask inputs as box_game.
+- Determinism: matmuls in float32 with fixed shapes — bit-reproducible per
+  platform+executable like every other model here (docs/determinism.md).
+
+Observation (8 features): bot velocity (2), vector to own target (2),
+distance to target (1), vector to flock centroid (2), bias (1).
+Action (4 logits): accelerate +x/-x/+y/-y, applied as tanh-squashed accel.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bevy_ggrs_tpu.schedule import InputSpec, PlayerInputs, Schedule
+from bevy_ggrs_tpu.state import HostWorld, TypeRegistry, WorldState
+
+INPUT_UP = 1 << 0
+INPUT_DOWN = 1 << 1
+INPUT_LEFT = 1 << 2
+INPUT_RIGHT = 1 << 3
+
+INPUT_SPEC = InputSpec(shape=(), dtype=jnp.uint8)
+
+OBS_DIM = 8
+HIDDEN = 32
+ACT_DIM = 4
+
+# Target slots are a fixed-shape rollback resource; the model supports up
+# to this many players (validated in make_world).
+MAX_PLAYERS = 8
+
+TARGET_SPEED = jnp.float32(0.12)
+ACCEL_SCALE = jnp.float32(0.02)
+MAX_SPEED = jnp.float32(0.15)
+WORLD_HALF = jnp.float32(6.0)
+
+
+def make_policy_params(seed: int = 0, hidden: int = HIDDEN):
+    """Deterministic MLP weights (fixed seed = part of the game's content)."""
+    rng = np.random.RandomState(seed)
+    scale1 = 1.0 / math.sqrt(OBS_DIM)
+    scale2 = 1.0 / math.sqrt(hidden)
+    return {
+        "w1": (rng.randn(OBS_DIM, hidden) * scale1).astype(np.float32),
+        "b1": np.zeros((hidden,), np.float32),
+        "w2": (rng.randn(hidden, ACT_DIM) * scale2).astype(np.float32),
+        "b2": np.zeros((ACT_DIM,), np.float32),
+    }
+
+
+def make_registry(hidden: int = HIDDEN) -> TypeRegistry:
+    reg = TypeRegistry()
+    reg.register_component("position", shape=(2,), dtype=jnp.float32)
+    reg.register_component("velocity", shape=(2,), dtype=jnp.float32)
+    # Which player's target this bot pursues.
+    reg.register_component("team", shape=(), dtype=jnp.int32, default=0)
+    # Per-player steerable target points (the "leaders" bots chase).
+    reg.register_resource("targets", np.zeros((MAX_PLAYERS, 2), np.float32))
+    reg.register_resource("policy", make_policy_params(hidden=hidden))
+    reg.register_resource("frame_count", jnp.uint32(0))
+    return reg
+
+
+def make_world(
+    num_bots: int,
+    num_players: int,
+    capacity: Optional[int] = None,
+    seed: int = 0,
+    hidden: int = HIDDEN,
+) -> HostWorld:
+    if not 1 <= num_players <= MAX_PLAYERS:
+        raise ValueError(
+            f"neural_bots supports 1..{MAX_PLAYERS} players "
+            f"(fixed-shape targets resource), got {num_players}"
+        )
+    capacity = num_bots if capacity is None else capacity
+    world = HostWorld(make_registry(hidden), capacity)
+    rng = np.random.RandomState(seed)
+    for i in range(num_bots):
+        ang = i * 2.399963
+        rad = 0.2 * math.sqrt(i + 1)
+        world.spawn(
+            {
+                "position": np.array(
+                    [rad * math.cos(ang), rad * math.sin(ang)], np.float32
+                ),
+                "velocity": rng.uniform(-0.02, 0.02, 2).astype(np.float32),
+                "team": np.int32(i % num_players),
+            },
+            rollback_id=i,
+        )
+    targets = np.zeros((MAX_PLAYERS, 2), np.float32)
+    for p in range(num_players):
+        ang = 2 * math.pi * p / num_players
+        targets[p] = [3.0 * math.cos(ang), 3.0 * math.sin(ang)]
+    world.set_resource("targets", targets)
+    return world
+
+
+def steer_targets_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
+    """Players move their target points with box_game-style bitmask keys."""
+    targets = state.resources["targets"]  # [8, 2]
+    num_players = inputs.num_players
+    bits = jnp.zeros((targets.shape[0],), jnp.uint32)
+    bits = bits.at[:num_players].set(inputs.bits.astype(jnp.uint32))
+    dx = (
+        ((bits & INPUT_RIGHT) != 0).astype(jnp.float32)
+        - ((bits & INPUT_LEFT) != 0).astype(jnp.float32)
+    )
+    dy = (
+        ((bits & INPUT_DOWN) != 0).astype(jnp.float32)
+        - ((bits & INPUT_UP) != 0).astype(jnp.float32)
+    )
+    moved = targets + jnp.stack([dx, dy], axis=1) * TARGET_SPEED
+    moved = jnp.clip(moved, -WORLD_HALF, WORLD_HALF)
+    return state.replace(resources={**state.resources, "targets": moved})
+
+
+def policy_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
+    """Batched MLP inference -> acceleration, then clamped integration.
+
+    The two matmuls ([cap, OBS] @ [OBS, H] and [cap, H] @ [H, 4]) are the
+    MXU work; everything else fuses around them.
+    """
+    del inputs
+    pos = state.components["position"]  # [cap, 2]
+    vel = state.components["velocity"]
+    team = jnp.clip(state.components["team"], 0, 7)
+    alive = state.alive
+    active = (alive & state.present["position"]).astype(jnp.float32)[:, None]
+
+    targets = state.resources["targets"][team]  # [cap, 2]
+    to_target = targets - pos
+    dist = jnp.sqrt(jnp.sum(to_target * to_target, axis=1, keepdims=True) + 1e-8)
+    n_alive = jnp.maximum(jnp.sum(active), 1.0)
+    centroid = jnp.sum(pos * active, axis=0, keepdims=True) / n_alive
+    to_centroid = centroid - pos
+
+    obs = jnp.concatenate(
+        [vel, to_target, dist, to_centroid, jnp.ones_like(dist)], axis=1
+    )  # [cap, 8]
+
+    p = state.resources["policy"]
+    hidden = jnp.tanh(obs @ p["w1"] + p["b1"])  # MXU
+    logits = hidden @ p["w2"] + p["b2"]  # MXU
+    act = jnp.tanh(logits)
+    accel = jnp.stack([act[:, 0] - act[:, 1], act[:, 2] - act[:, 3]], axis=1)
+
+    new_vel = vel + accel * ACCEL_SCALE
+    speed = jnp.sqrt(jnp.sum(new_vel * new_vel, axis=1, keepdims=True) + 1e-12)
+    new_vel = new_vel * jnp.minimum(1.0, MAX_SPEED / speed)
+    new_pos = jnp.clip(pos + new_vel, -WORLD_HALF, WORLD_HALF)
+
+    sel = active.astype(bool)
+    return state.replace(
+        components={
+            **state.components,
+            "position": jnp.where(sel, new_pos, pos),
+            "velocity": jnp.where(sel, new_vel, vel),
+        }
+    )
+
+
+def increase_frame_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
+    del inputs
+    return state.replace(
+        resources={
+            **state.resources,
+            "frame_count": state.resources["frame_count"] + jnp.uint32(1),
+        }
+    )
+
+
+def make_schedule() -> Schedule:
+    return Schedule([steer_targets_system, policy_system, increase_frame_system])

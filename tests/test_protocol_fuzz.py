@@ -1,0 +1,66 @@
+"""Wire-protocol robustness: decode() must never raise on untrusted bytes.
+
+The UDP socket delivers attacker-controlled datagrams straight into
+``protocol.decode`` (survey §2.4 boundary); the contract is None for
+garbage, never an exception. Seeded fuzz over random bytes, truncated valid
+messages, and bit-flipped valid messages; plus encode/decode round-trip
+equality for every message type.
+"""
+
+import numpy as np
+
+from bevy_ggrs_tpu.session import protocol as proto
+
+
+def _valid_messages():
+    return [
+        proto.SyncRequest(nonce=0xDEADBEEF),
+        proto.SyncReply(nonce=1),
+        proto.InputMsg(handle=2, start_frame=100, payload=b"\x01\x02\x03",
+                       num=3, ack_frame=99, sender_frame=103, advantage=-2),
+        proto.InputAck(handle=0, ack_frame=-1),
+        proto.QualityReport(send_time_ms=123456, frame_advantage=7),
+        proto.QualityReply(pong_time_ms=999),
+        proto.KeepAlive(),
+        proto.ChecksumReport(frame=64, checksum=0xFFFFFFFF),
+    ]
+
+
+def test_round_trip_every_type():
+    for msg in _valid_messages():
+        got = proto.decode(proto.encode(msg))
+        assert got == msg, (msg, got)
+
+
+def test_random_bytes_never_raise():
+    rng = np.random.RandomState(0)
+    for _ in range(2000):
+        n = int(rng.randint(0, 64))
+        data = rng.bytes(n)
+        proto.decode(data)  # must not raise; None or a Message both fine
+
+
+def test_truncations_never_raise():
+    for msg in _valid_messages():
+        wire = proto.encode(msg)
+        for cut in range(len(wire)):
+            proto.decode(wire[:cut])
+
+
+def test_bit_flips_never_raise():
+    rng = np.random.RandomState(1)
+    for msg in _valid_messages():
+        wire = bytearray(proto.encode(msg))
+        for _ in range(50):
+            flipped = bytearray(wire)
+            i = int(rng.randint(0, len(flipped)))
+            flipped[i] ^= 1 << int(rng.randint(0, 8))
+            proto.decode(bytes(flipped))
+
+
+def test_wrong_magic_and_version_rejected():
+    wire = bytearray(proto.encode(proto.KeepAlive()))
+    bad_magic = bytes([wire[0] ^ 0xFF]) + bytes(wire[1:])
+    assert proto.decode(bad_magic) is None
+    bad_version = bytes([wire[0], wire[1] + 1]) + bytes(wire[2:])
+    assert proto.decode(bad_version) is None
