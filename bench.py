@@ -72,14 +72,21 @@ def _time_rollout(ex, state, bits, iters: int = 20):
 
 def _box_game_case(players: int, frames: int, branches: int, seed: int = 0):
     from bevy_ggrs_tpu.models import box_game
+
+    return _spec_case(box_game.make_schedule(),
+                      box_game.make_world(players).commit(),
+                      players, frames, branches, seed)
+
+
+def _spec_case(schedule, state, players: int, frames: int, branches: int,
+               seed: int):
+    """Shared executor + branch-tensor setup for every rollout config."""
     from bevy_ggrs_tpu.parallel.speculate import (
         SpeculativeExecutor,
         bitmask_sampler,
         enumerate_branches,
     )
 
-    schedule = box_game.make_schedule()
-    state = box_game.make_world(players).commit()
     ex = SpeculativeExecutor(schedule, branches, frames)
     bits = enumerate_branches(
         jax.random.PRNGKey(seed),
@@ -91,26 +98,21 @@ def _box_game_case(players: int, frames: int, branches: int, seed: int = 0):
     return ex, state, jax.block_until_ready(bits)
 
 
+def _neural_bots_case(num_bots: int, players: int, frames: int, branches: int):
+    from bevy_ggrs_tpu.models import neural_bots
+
+    return _spec_case(neural_bots.make_schedule(),
+                      neural_bots.make_world(num_bots, players).commit(),
+                      players, frames, branches, seed=7)
+
+
 def _boids_case(num_boids: int, players: int, frames: int, branches: int,
                 use_pallas: bool):
     from bevy_ggrs_tpu.models import boids
-    from bevy_ggrs_tpu.parallel.speculate import (
-        SpeculativeExecutor,
-        bitmask_sampler,
-        enumerate_branches,
-    )
 
-    schedule = boids.make_schedule(use_pallas=use_pallas)
-    state = boids.make_world(num_boids, players).commit()
-    ex = SpeculativeExecutor(schedule, branches, frames)
-    bits = enumerate_branches(
-        jax.random.PRNGKey(4),
-        jnp.zeros((players,), jnp.uint8),
-        branches,
-        frames,
-        sampler=bitmask_sampler(),
-    )
-    return ex, state, jax.block_until_ready(bits)
+    return _spec_case(boids.make_schedule(use_pallas=use_pallas),
+                      boids.make_world(num_boids, players).commit(),
+                      players, frames, branches, seed=4)
 
 
 def _host_device_rtt_ms() -> float:
@@ -253,6 +255,8 @@ _CONFIGS = {
     "boids_1k_8f_x_128b_pallas": (lambda: _boids_case(1024, 2, 8, 128, True), 8, 128),
     # 5: depth × breadth stress — 8 players, 12 frames, 1024-branch tree.
     "box_game_8p_12f_x_1024b": (lambda: _box_game_case(8, 12, 1024), 12, 1024),
+    # MXU model family: batched MLP inference inside the rollback domain.
+    "neural_bots_512_8f_x_64b": (lambda: _neural_bots_case(512, 2, 8, 64), 8, 64),
 }
 
 # North-star recovery-latency comparisons (speculative commit vs serial

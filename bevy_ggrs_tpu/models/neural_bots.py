@@ -150,7 +150,7 @@ def policy_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
     del inputs
     pos = state.components["position"]  # [cap, 2]
     vel = state.components["velocity"]
-    team = jnp.clip(state.components["team"], 0, 7)
+    team = jnp.clip(state.components["team"], 0, MAX_PLAYERS - 1)
     alive = state.alive
     active = (alive & state.present["position"]).astype(jnp.float32)[:, None]
 
