@@ -131,3 +131,21 @@ def test_missing_input_rejected():
 def test_check_distance_beyond_prediction_rejected():
     with pytest.raises(InvalidRequest):
         SyncTestSession(2, box_game.INPUT_SPEC, check_distance=9, max_prediction=8)
+
+
+def test_deep_prediction_window():
+    """The temporal axis at 4x the reference's example depth: a 32-frame
+    prediction window with 30-deep forced rollbacks every frame (the
+    'long-context' analog, survey §5 — the frame axis is a lax.scan, so
+    depth costs compile-time shape only, not host round trips)."""
+    session, runner = make(check_distance=30, max_prediction=32)
+    sched = box_game.make_schedule()
+    oracle = box_game.make_world(2).commit()
+    for i in range(40):
+        bits = np.asarray([(i + h) % 16 for h in range(2)], np.uint8)
+        tick(session, runner, bits)
+        oracle = sched(oracle, make_inputs(bits))
+    assert runner.frame == 40
+    assert runner.rollback_frames_total >= 30 * 9  # deep resims really ran
+    # And the deeply-resimulated state equals straight-line simulation.
+    assert int(checksum(runner.state)) == int(checksum(oracle))
