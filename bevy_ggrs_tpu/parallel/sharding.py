@@ -118,3 +118,23 @@ def shard_world(state, mesh: Mesh, entity_axis: str = "entity"):
     return jax.tree_util.tree_map(
         jax.device_put, state, to_named(world_pspecs(state, entity_axis), mesh)
     )
+
+
+def world_and_ring_shardings(
+    state_template, mesh: Mesh, entity_axis: str, prefix: tuple = ()
+):
+    """The (world, snapshot-ring) sharding pair every executor needs:
+    world leaves split on ``entity_axis``, ring leaves gain a replicated
+    depth axis, and ``prefix`` names any leading batch axes (the
+    speculative executor passes ``(branch_axis,)``; the serial executor
+    none). Shared so the recipe can't drift between the two paths."""
+    from bevy_ggrs_tpu.state import SnapshotRing
+
+    sspec = world_pspecs(state_template, entity_axis)
+    state_s = to_named(prepend_axes(sspec, *prefix), mesh)
+    ring_s = SnapshotRing(
+        states=to_named(prepend_axes(sspec, *prefix, None), mesh),
+        frames=NamedSharding(mesh, P(*prefix)),
+        checksums=NamedSharding(mesh, P(*prefix)),
+    )
+    return state_s, ring_s

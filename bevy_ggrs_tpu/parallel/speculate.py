@@ -188,10 +188,8 @@ class SpeculativeExecutor:
 
             from bevy_ggrs_tpu.parallel.sharding import (
                 branch_pspec,
-                prepend_axes,
                 replicated,
-                to_named,
-                world_pspecs,
+                world_and_ring_shardings,
             )
 
             spec_b = branch_pspec(mesh, branch_axis)
@@ -201,17 +199,11 @@ class SpeculativeExecutor:
                     raise ValueError(
                         "entity_axis sharding needs a state_template"
                     )
-                sspec = world_pspecs(state_template, entity_axis)
-                state_in = to_named(sspec, mesh)
-                states_out = to_named(
-                    prepend_axes(sspec, branch_axis), mesh
+                state_in, _ = world_and_ring_shardings(
+                    state_template, mesh, entity_axis
                 )
-                rings_out = SnapshotRing(
-                    states=to_named(
-                        prepend_axes(sspec, branch_axis, None), mesh
-                    ),
-                    frames=branch_pspec(mesh, branch_axis),
-                    checksums=branch_pspec(mesh, branch_axis),
+                states_out, rings_out = world_and_ring_shardings(
+                    state_template, mesh, entity_axis, prefix=(branch_axis,)
                 )
                 self._run = jax.jit(
                     run,

@@ -100,10 +100,40 @@ class RolloutExecutor:
     call.
     """
 
-    def __init__(self, schedule: Schedule, max_frames: int):
+    def __init__(self, schedule: Schedule, max_frames: int, mesh=None,
+                 entity_axis: str = "entity", state_template=None):
+        """With ``mesh`` + ``state_template``, the world's entity/capacity
+        axis is split over ``mesh``'s ``entity_axis`` for every call — the
+        serial-path analog of the SpeculativeExecutor's entity sharding:
+        world and ring stay distributed across chips for the whole session,
+        GSPMD inserting collectives inside entity-coupled systems. Bitwise
+        caveat: integer state and the checksum (a wrapping sum, exactly
+        order-independent) match the unsharded layout; float reductions
+        inside user systems may round differently per layout
+        (docs/determinism.md)."""
         self.schedule = schedule
         self.max_frames = int(max_frames)
-        self._fn = jax.jit(functools.partial(self._run_impl, schedule))
+        run = functools.partial(self._run_impl, schedule)
+        if mesh is not None:
+            if state_template is None:
+                raise ValueError("mesh sharding needs a state_template")
+            from bevy_ggrs_tpu.parallel.sharding import (
+                replicated,
+                world_and_ring_shardings,
+            )
+
+            state_s, ring_s = world_and_ring_shardings(
+                state_template, mesh, entity_axis
+            )
+            rep = replicated(mesh)
+            self._fn = jax.jit(
+                run,
+                in_shardings=(ring_s, state_s, rep, rep, rep, rep, rep, rep,
+                              rep),
+                out_shardings=(ring_s, state_s, rep),
+            )
+        else:
+            self._fn = jax.jit(run)
 
     @staticmethod
     def _run_impl(schedule, ring, state, do_load, load_frame, start_frame,

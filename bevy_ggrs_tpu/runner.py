@@ -51,20 +51,29 @@ class RollbackRunner:
         input_spec,
         report_checksums: bool = True,
         metrics=None,
+        mesh=None,
+        entity_axis: str = "entity",
     ):
         from bevy_ggrs_tpu.utils.metrics import null_metrics
 
         self.metrics = metrics if metrics is not None else null_metrics
         self.schedule = schedule
-        self.state = initial_state
         self.num_players = int(num_players)
         self.input_spec = input_spec
         self.max_prediction = int(max_prediction)
+        if mesh is not None:
+            from bevy_ggrs_tpu.parallel.sharding import shard_world
+
+            initial_state = shard_world(initial_state, mesh, entity_axis)
+        self.state = initial_state
         # Ring depth mirrors the reference's max_prediction sizing
         # (`ggrs_stage.rs:169-173,219-224`) +1 slack for the save of the
         # frame being left.
         self.ring = ring_init(initial_state, self.max_prediction + 1)
-        self.executor = RolloutExecutor(schedule, self.max_prediction + 2)
+        self.executor = RolloutExecutor(
+            schedule, self.max_prediction + 2, mesh=mesh,
+            entity_axis=entity_axis, state_template=initial_state,
+        )
         self.frame = 0
         self.report_checksums = report_checksums
         self.rollback_frames_total = 0  # observability: resimulated frames
