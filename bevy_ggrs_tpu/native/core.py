@@ -73,7 +73,9 @@ def _load():
         ctypes.c_void_p, ctypes.c_int, ctypes.c_int32, u8p]
     lib.ggrs_qs_input.restype = ctypes.c_int
     lib.ggrs_qs_discard_before.argtypes = [ctypes.c_void_p, ctypes.c_int32]
-    lib.ggrs_qs_reset.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int32]
+    lib.ggrs_qs_reset.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                  ctypes.c_int32, u8p]
+    lib.ggrs_qs_last_input.argtypes = [ctypes.c_void_p, ctypes.c_int, u8p]
     lib.ggrs_qs_min_confirmed.argtypes = [ctypes.c_void_p, u8p]
     lib.ggrs_qs_min_confirmed.restype = ctypes.c_int32
     lib.ggrs_qs_gather.argtypes = [
@@ -130,8 +132,21 @@ class _NativeQueueView:
     def last_confirmed_frame(self) -> int:
         return int(_lib.ggrs_qs_last_confirmed(self._qs._ptr, self._h))
 
-    def reset(self, next_frame: int) -> None:
-        _lib.ggrs_qs_reset(self._qs._ptr, self._h, int(next_frame))
+    def reset(self, next_frame: int, last_input=None) -> None:
+        if last_input is None:
+            _lib.ggrs_qs_reset(self._qs._ptr, self._h, int(next_frame), None)
+        else:
+            _lib.ggrs_qs_reset(
+                self._qs._ptr, self._h, int(next_frame),
+                _u8p(self._qs._in(last_input)),
+            )
+
+    @property
+    def last_input(self) -> np.ndarray:
+        """The repeat-last prediction source (for checkpointing)."""
+        flat = self._qs._out_flat(1)
+        _lib.ggrs_qs_last_input(self._qs._ptr, self._h, _u8p(flat))
+        return self._qs._decode_one(flat)
 
     def add_input(self, frame: int, bits) -> Optional[int]:
         got = int(

@@ -88,13 +88,18 @@ struct Queue {
   }
 
   // Checkpoint-restore support: forget all history and make `next_frame`
-  // the next contiguous frame add_input accepts. Prediction source resets
-  // to the zero input (the restorer replays the in-window inputs after).
-  void reset(int32_t next_frame) {
+  // the next contiguous frame add_input accepts. The prediction source
+  // resets to `last` when given (a restored repeat-last value for players
+  // whose history fell outside the checkpoint window), else to zero (the
+  // restorer replays the in-window inputs after, which re-derives it).
+  void reset(int32_t next_frame, const uint8_t* last) {
     inputs.clear();
     base = next_frame;
     last_confirmed = next_frame - 1;
-    last_input = zero;
+    if (last)
+      last_input.assign(last, last + input_bytes);
+    else
+      last_input = zero;
   }
 };
 
@@ -171,8 +176,14 @@ void ggrs_qs_discard_before(void* p, int32_t frame) {
   for (Queue& q : static_cast<QueueSet*>(p)->queues) q.discard_before(frame);
 }
 
-void ggrs_qs_reset(void* p, int handle, int32_t next_frame) {
-  static_cast<QueueSet*>(p)->queues[size_t(handle)].reset(next_frame);
+void ggrs_qs_reset(void* p, int handle, int32_t next_frame,
+                   const uint8_t* last) {
+  static_cast<QueueSet*>(p)->queues[size_t(handle)].reset(next_frame, last);
+}
+
+void ggrs_qs_last_input(void* p, int handle, uint8_t* out) {
+  const Queue& q = static_cast<QueueSet*>(p)->queues[size_t(handle)];
+  std::memcpy(out, q.last_input.data(), size_t(q.input_bytes));
 }
 
 // Highest frame confirmed for every connected player (connected[h] != 0);

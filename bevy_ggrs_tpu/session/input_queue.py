@@ -29,6 +29,11 @@ class InputQueue:
     def last_confirmed_frame(self) -> int:
         return self._last_confirmed
 
+    @property
+    def last_input(self) -> np.ndarray:
+        """The repeat-last prediction source (for checkpointing)."""
+        return self._last_input.copy()
+
     def add_input(self, frame: int, bits) -> Optional[int]:
         """Record the confirmed input for ``frame``. Out-of-order or
         duplicate frames ≤ last confirmed are ignored (network redundancy:
@@ -85,11 +90,17 @@ class InputQueue:
         for f in [f for f in self._inputs if f < frame]:
             del self._inputs[f]
 
-    def reset(self, next_frame: int) -> None:
+    def reset(self, next_frame: int, last_input=None) -> None:
         """Checkpoint-restore support: forget all history and make
         ``next_frame`` the next contiguous frame :meth:`add_input` accepts.
-        Prediction source resets to the zero input (the restorer replays the
-        in-window inputs afterwards)."""
+        The prediction source resets to ``last_input`` when given (restored
+        repeat-last value for players whose history fell outside the
+        checkpoint window), else to zero (the restorer replays the
+        in-window inputs afterwards, which re-derives it)."""
         self._inputs.clear()
         self._last_confirmed = int(next_frame) - 1
-        self._last_input = self._zero
+        self._last_input = (
+            self._zero if last_input is None
+            else np.asarray(last_input, dtype=self._zero.dtype).reshape(
+                self._zero.shape)
+        )

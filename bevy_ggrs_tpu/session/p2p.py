@@ -318,6 +318,136 @@ class P2PSession:
         self._on_peer_disconnected(addr)
 
     # ------------------------------------------------------------------
+    # Checkpoint / resume (host crash recovery)
+
+    # How far below current_frame state_dict probes for surviving history
+    # (the GC horizon is dynamic; this just bounds the probe loop). Must
+    # exceed SPECTATOR_MAX_LAG: the GC floor retains input history back to
+    # the laggiest live spectator's cursor, and a checkpoint that truncated
+    # it would leave a resumed host unable to continue that fan-out.
+    _CKPT_PROBE = SPECTATOR_MAX_LAG + 128
+
+    def state_dict(self) -> Dict:
+        """JSON-serializable local session state for crash recovery.
+
+        Captures frame counters, per-player confirmed-input history and
+        used-input (prediction) records within the GC window, disconnect
+        map, spectator fan-out cursors, and checksum-exchange state.
+        Endpoint/network state is deliberately NOT captured: a restored
+        host builds fresh endpoints and re-runs the sync handshake (live
+        peers answer SyncRequest while RUNNING), and input-span redundancy
+        re-delivers anything in flight at crash time. Checkpoint at tick
+        boundaries (after ``handle_requests``), like CheckpointManager
+        does."""
+        lo = max(0, self.current_frame - self._CKPT_PROBE)
+        inputs: Dict[str, Dict[str, list]] = {}
+        queue_meta: Dict[str, Dict] = {}
+        for h, q in enumerate(self._queues):
+            per: Dict[str, list] = {}
+            for f in range(lo, q.last_confirmed_frame + 1):
+                got = q.confirmed(f)
+                if got is not None:
+                    per[str(f)] = np.asarray(got).tolist()
+            inputs[str(h)] = per
+            # Confirmed frontier + prediction source survive even when the
+            # span itself fell outside the probe window (long-disconnected
+            # players): the restored queue must keep predicting the FROZEN
+            # last input, not zeros, or survivors desync.
+            queue_meta[str(h)] = {
+                "last_confirmed": int(q.last_confirmed_frame),
+                "last_input": np.asarray(q.last_input).tolist(),
+            }
+        used: Dict[str, list] = {}
+        for f in range(lo, self.current_frame):
+            got = self._tracker.get_used(f)
+            if got is not None:
+                bits, status = got
+                used[str(f)] = [np.asarray(bits).tolist(),
+                                np.asarray(status).tolist()]
+        return {
+            "current_frame": self.current_frame,
+            "inputs": inputs,
+            "queue_meta": queue_meta,
+            "used": used,
+            "disconnected": {str(h): int(f)
+                             for h, f in self._disconnected.items()},
+            "spec_sent": {str(i): int(self._spec_sent[a])
+                          for i, a in enumerate(self._spectator_addrs)},
+            "checksums": {str(f): int(c)
+                          for f, c in self._local_checksums.items()},
+            "last_checksum_sent": int(self._last_checksum_sent),
+        }
+
+    def load_state_dict(self, sd: Dict) -> None:
+        """Restore :meth:`state_dict` into a freshly constructed session
+        (same topology/knobs/socket binding). Used-input records replay
+        first, then every confirmed input re-notes against them — so a
+        misprediction that was pending at crash time re-derives its
+        ``first_incorrect`` and the next ``advance_frame`` emits the same
+        rollback the crashed session would have."""
+        self.current_frame = int(sd["current_frame"])
+        dtype = self._zero.dtype
+        shape = self._zero.shape
+        for f_str in sorted(sd["used"], key=int):
+            bits, status = sd["used"][f_str]
+            self._tracker.record_used(
+                int(f_str),
+                np.asarray(bits, dtype=dtype).reshape((self.num_players,) + shape),
+                np.asarray(status, np.int32),
+            )
+        for h, q in enumerate(self._queues):
+            per = sd["inputs"].get(str(h), {})
+            meta = sd.get("queue_meta", {}).get(str(h), {})
+            frames = sorted(int(f) for f in per)
+            last = meta.get("last_input")
+            if last is not None:
+                last = np.asarray(last, dtype=dtype).reshape(shape)
+            if frames:
+                q.reset(frames[0], last)
+                for f in frames:
+                    arr = np.asarray(per[str(f)], dtype=dtype).reshape(shape)
+                    q.add_input(f, arr)
+                    # Re-derive pending mispredictions vs the used records.
+                    self._tracker.note_confirmed(h, f, arr)
+            else:
+                # No surviving span (player dead long before the
+                # checkpoint): restore the confirmed frontier + frozen
+                # prediction source directly.
+                q.reset(int(meta.get("last_confirmed", -1)) + 1, last)
+        self._disconnected = {
+            int(h): int(f) for h, f in sd["disconnected"].items()
+        }
+        # Dead peers' fresh endpoints must not gate the sync handshake (a
+        # SYNCHRONIZING endpoint for a player who disconnected pre-crash
+        # would park current_state() forever).
+        for h, _f in self._disconnected.items():
+            addr = self._handle_addr.get(h)
+            ep = self._endpoints.get(addr)
+            if ep is not None and ep.state != PeerState.DISCONNECTED:
+                ep.force_disconnect()
+                ep.events.clear()  # restored fact, not a new event
+        for i, a in enumerate(self._spectator_addrs):
+            if str(i) in sd.get("spec_sent", {}):
+                self._spec_sent[a] = int(sd["spec_sent"][str(i)])
+        self._local_checksums = {
+            int(f): int(c) for f, c in sd["checksums"].items()
+        }
+        self._last_checksum_sent = int(sd.get("last_checksum_sent", -1))
+        self._pending_local.clear()
+        # Local input history must be re-offered to peers: endpoint ack
+        # state died with the endpoints, and peers may have missed the
+        # in-flight tail. Spans are idempotent receiver-side (stale frames
+        # are dropped), so re-queue everything surviving in the local
+        # queues.
+        for h in self.local_handles:
+            q = self._queues[h]
+            for f_str in sorted(sd["inputs"].get(str(h), {}), key=int):
+                got = q.confirmed(int(f_str))
+                if got is not None:
+                    for addr in self._handle_addr.values():
+                        self._endpoints[addr].queue_input(h, int(f_str), got)
+
+    # ------------------------------------------------------------------
     # Checksums / desync detection
 
     def wants_checksum(self, frame: int) -> bool:

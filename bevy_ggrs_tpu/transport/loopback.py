@@ -83,3 +83,8 @@ class LoopbackSocket:
     def receive_all(self) -> List[Tuple[object, bytes]]:
         out, self._inbox = self._inbox, []
         return out
+
+    def close(self) -> None:
+        """Release the address (a crashed process's port closing); the
+        address can then be re-bound by a restarted peer."""
+        self._network._sockets.pop(self.addr, None)

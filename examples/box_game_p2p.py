@@ -51,6 +51,13 @@ def main() -> int:
     parser.add_argument("--speculate", type=int, default=0, metavar="B",
                         help="precompute rollback recoveries with B "
                              "speculative input branches per frame (0 = off)")
+    parser.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                        help="write rolling crash-recovery checkpoints "
+                             "(runner + session) into DIR")
+    parser.add_argument("--checkpoint-interval", type=int, default=60)
+    parser.add_argument("--resume", action="store_true",
+                        help="restore the newest checkpoint from "
+                             "--checkpoint-dir before joining")
     add_common_args(parser)
     args = parser.parse_args()
     force_platform(args.platform)
@@ -88,11 +95,27 @@ def main() -> int:
     app.add_render_system(print_events_system)
     app.add_render_system(make_stats_system())
 
+    mgr = None
+    if args.checkpoint_dir:
+        from bevy_ggrs_tpu.utils.persistence import CheckpointManager
+
+        mgr = CheckpointManager(args.checkpoint_dir,
+                                interval=args.checkpoint_interval)
+        if args.resume:
+            meta = mgr.restore_latest(app.stage.runner, session=session)
+            if meta is not None:
+                print(f"[resume] restored frame {meta['frame']} from "
+                      f"{args.checkpoint_dir}")
+            else:
+                print("[resume] no usable checkpoint; starting fresh")
+
     dt = 1.0 / args.fps
     with inst:
         for _ in range(args.frames):
             t0 = time.monotonic()
             app.update()
+            if mgr is not None and session.current_state().name == "RUNNING":
+                mgr.maybe_save(app.stage.runner, session=session)
             lead = dt - (time.monotonic() - t0)
             if lead > 0:
                 time.sleep(lead)

@@ -1,0 +1,184 @@
+"""P2P host crash recovery: checkpoint, die, restore, re-sync, agree.
+
+Peer A checkpoints every few frames (runner + session via persistence).
+Mid-session A "crashes" (socket closed, all objects dropped), restarts
+from the newest checkpoint with fresh endpoints, re-runs the sync
+handshake against the still-live peer B (endpoints answer SyncRequest
+while RUNNING), and the pair converges: B sees interrupt→resume, both
+advance, and every exchanged checksum boundary agrees — no desync.
+"""
+
+import numpy as np
+
+from bevy_ggrs_tpu.models import box_game
+from bevy_ggrs_tpu.runner import RollbackRunner
+from bevy_ggrs_tpu.session import (
+    EventKind,
+    PlayerType,
+    PredictionThreshold,
+    SessionBuilder,
+    SessionState,
+)
+from bevy_ggrs_tpu.transport.loopback import LoopbackNetwork
+from bevy_ggrs_tpu.utils.persistence import restore_runner, save_runner
+
+from tests.test_p2p import FPS_DT, common_confirmed_checksums, scripted_input
+
+MAXPRED = 8
+
+
+def build_peer(net, me, clock):
+    builder = (
+        SessionBuilder(box_game.INPUT_SPEC)
+        .with_num_players(2)
+        .with_max_prediction_window(MAXPRED)
+    )
+    for h in range(2):
+        if h == me:
+            builder.add_player(PlayerType.local(), h)
+        else:
+            builder.add_player(PlayerType.remote(("peer", h)), h)
+    sock = net.socket(("peer", me))
+    session = builder.start_p2p_session(sock, clock=clock)
+    runner = RollbackRunner(
+        box_game.make_schedule(), box_game.make_world(2).commit(),
+        max_prediction=MAXPRED, num_players=2, input_spec=box_game.INPUT_SPEC,
+    )
+    return session, runner, sock
+
+
+def tick(net, session, runner):
+    session.poll_remote_clients()
+    events = session.events()
+    if session.current_state() != SessionState.RUNNING:
+        return events
+    for h in session.local_player_handles():
+        session.add_local_input(h, scripted_input(h, session.current_frame))
+    try:
+        requests = session.advance_frame()
+    except PredictionThreshold:
+        return events
+    runner.handle_requests(requests, session)
+    return events
+
+
+def test_host_crash_restore_resync(tmp_path):
+    net = LoopbackNetwork(latency=1.5 * FPS_DT, seed=21)
+    clock = lambda: net.now
+    sess_a, run_a, sock_a = build_peer(net, 0, clock)
+    sess_b, run_b, sock_b = build_peer(net, 1, clock)
+    ckpt = str(tmp_path / "host.npz")
+
+    events_b = []
+    for i in range(60):
+        net.advance(FPS_DT)
+        tick(net, sess_a, run_a)
+        events_b += tick(net, sess_b, run_b)
+        if i % 5 == 0 and sess_a.current_state() == SessionState.RUNNING:
+            save_runner(ckpt, run_a, session=sess_a)
+    frame_at_crash = run_a.frame
+    assert frame_at_crash > 30
+
+    # --- crash A: socket closes, objects die --------------------------
+    sock_a.close()
+    del sess_a, run_a
+
+    # B keeps running alone for a while (will stall at the prediction
+    # threshold and mark A interrupted; notify starts after 0.5s = 30
+    # virtual frames, so run well past it).
+    for _ in range(50):
+        net.advance(FPS_DT)
+        events_b += tick(net, sess_b, run_b)
+    assert any(e.kind == EventKind.NETWORK_INTERRUPTED for e in events_b)
+
+    # --- restart A from the newest checkpoint -------------------------
+    sess_a2, run_a2, _ = build_peer(net, 0, clock)
+    meta = restore_runner(ckpt, run_a2, session=sess_a2)
+    assert run_a2.frame == sess_a2.current_frame == meta["frame"]
+    assert run_a2.frame <= frame_at_crash
+
+    events_a2 = []
+    for _ in range(200):
+        net.advance(FPS_DT)
+        events_a2 += tick(net, sess_a2, run_a2)
+        events_b += tick(net, sess_b, run_b)
+
+    # Re-synced and progressing on both sides.
+    assert sess_a2.current_state() == SessionState.RUNNING
+    assert any(e.kind == EventKind.SYNCHRONIZED for e in events_a2)
+    assert any(e.kind == EventKind.NETWORK_RESUMED for e in events_b)
+    assert run_a2.frame > frame_at_crash
+    assert run_b.frame > frame_at_crash
+    # All post-resume exchanged checksums agree; desync never fired.
+    frames, pairs = common_confirmed_checksums([(sess_a2, run_a2),
+                                                (sess_b, run_b)])
+    assert frames, "no common checksum boundaries after resume"
+    assert all(a == b for a, b in pairs)
+    assert not any(e.kind == EventKind.DESYNC_DETECTED
+                   for e in events_a2 + events_b)
+
+
+def test_resume_with_dead_player_does_not_block_sync(tmp_path):
+    """A player who disconnected BEFORE the checkpoint must not park the
+    restored session in SYNCHRONIZING (its fresh endpoint is
+    force-disconnected at restore), and the frozen repeat-last prediction
+    for the dead player survives the round trip."""
+    from tests.test_p2p_multi import make_group, step_peer
+
+    net = LoopbackNetwork(latency=1 * FPS_DT, seed=4)
+    peers = make_group(net, 3, disconnect_timeout=0.3)
+    ckpt = str(tmp_path / "abc.npz")
+
+    # Everyone alive for a while.
+    for _ in range(30):
+        net.advance(FPS_DT)
+        for s, r in peers:
+            step_peer(s, r, scripted_input)
+    # C (handle 2) dies; A and B continue past the disconnect timeout.
+    for _ in range(40):
+        net.advance(FPS_DT)
+        for s, r in peers[:2]:
+            step_peer(s, r, scripted_input)
+    sa, ra = peers[0]
+    assert 2 in sa._disconnected
+    frozen = np.asarray(sa._queues[2].last_input).copy()
+    save_runner(ckpt, ra, session=sa)
+    crash_frame = ra.frame
+
+    # A crashes and restarts; only B (and dead C's silence) remain.
+    sa.socket.close()
+    del sa, ra
+    peers[0] = (None, None)
+    net.advance(10 * FPS_DT)
+
+    sock = net.socket(("peer", 0))
+    builder = (
+        SessionBuilder(box_game.INPUT_SPEC)
+        .with_num_players(3)
+        .with_max_prediction_window(8)
+        .with_disconnect_timeout(0.3)
+    )
+    builder.add_player(PlayerType.local(), 0)
+    builder.add_player(PlayerType.remote(("peer", 1)), 1)
+    builder.add_player(PlayerType.remote(("peer", 2)), 2)
+    sess_a2 = builder.start_p2p_session(sock, clock=lambda: net.now)
+    run_a2 = RollbackRunner(
+        box_game.make_schedule(), box_game.make_world(3).commit(),
+        max_prediction=8, num_players=3, input_spec=box_game.INPUT_SPEC,
+    )
+    restore_runner(ckpt, run_a2, session=sess_a2)
+    assert 2 in sess_a2._disconnected
+    np.testing.assert_array_equal(
+        np.asarray(sess_a2._queues[2].last_input), frozen
+    )
+
+    sb, rb = peers[1]
+    for _ in range(150):
+        net.advance(FPS_DT)
+        step_peer(sess_a2, run_a2, scripted_input)
+        step_peer(sb, rb, scripted_input)
+    # Re-synced with B despite C's endpoint never answering.
+    assert sess_a2.current_state() == SessionState.RUNNING
+    assert run_a2.frame > crash_frame
+    frames, pairs = common_confirmed_checksums([(sess_a2, run_a2), (sb, rb)])
+    assert frames and all(a == b for a, b in pairs)
