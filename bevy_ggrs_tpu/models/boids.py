@@ -107,7 +107,11 @@ def flock_system_pallas(state: WorldState, inputs: PlayerInputs) -> WorldState:
     Pallas kernel (:mod:`bevy_ggrs_tpu.ops.pairwise`) instead of XLA's dense
     [N, N] broadcast. allclose to — but not bitwise-equal with — the XLA
     path; pick one per session (float caveat, reference
-    ``examples/README.md:13-18``)."""
+    ``examples/README.md:13-18``). Under entity-axis sharding this stays
+    CORRECT but not distributed: GSPMD cannot partition a custom call, so
+    it gathers around the kernel — prefer the XLA path (which GSPMD
+    partitions) for entity-sharded runs, the Pallas path for single-chip
+    branch-parallel runs."""
     from bevy_ggrs_tpu.ops.pairwise import pairwise_force_rows_pallas
 
     def forces(pos, vel, active):

@@ -87,3 +87,53 @@ class NetworkStats:
     kbps_sent: float = 0.0
     local_frames_behind: int = 0
     remote_frames_behind: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint span (de)serialization, shared by every session flavor
+# ---------------------------------------------------------------------------
+
+
+def serialize_spans(queues, lo: int) -> dict:
+    """JSON-encode each queue's surviving confirmed span from ``lo`` up."""
+    import numpy as np
+
+    out = {}
+    for h, q in enumerate(queues):
+        per = {}
+        for f in range(lo, q.last_confirmed_frame + 1):
+            got = q.confirmed(f)
+            if got is not None:
+                per[str(f)] = np.asarray(got).tolist()
+        out[str(h)] = per
+    return out
+
+
+def restore_spans(queues, inputs_sd: dict, default_start: int, dtype, shape,
+                  meta: Optional[dict] = None, on_confirmed=None) -> None:
+    """Inverse of :func:`serialize_spans`: reset each queue and replay its
+    span through the exact-frame path (no re-applied delay). ``meta``
+    optionally carries per-queue ``{"last_confirmed", "last_input"}`` so a
+    queue with NO surviving span (player dead long before the checkpoint)
+    keeps its confirmed frontier and frozen repeat-last prediction.
+    ``on_confirmed(h, frame, bits)`` fires per restored input (the P2P
+    session re-notes them against used records to re-derive pending
+    rollbacks)."""
+    import numpy as np
+
+    for h, q in enumerate(queues):
+        per = (inputs_sd or {}).get(str(h), {})
+        m = (meta or {}).get(str(h), {})
+        frames = sorted(int(f) for f in per)
+        last = m.get("last_input")
+        if last is not None:
+            last = np.asarray(last, dtype=dtype).reshape(shape)
+        if frames:
+            q.reset(frames[0], last)
+            for f in frames:
+                arr = np.asarray(per[str(f)], dtype=dtype).reshape(shape)
+                q.add_input(f, arr)
+                if on_confirmed is not None:
+                    on_confirmed(h, f, arr)
+        else:
+            q.reset(int(m.get("last_confirmed", default_start - 1)) + 1, last)

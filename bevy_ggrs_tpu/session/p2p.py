@@ -44,6 +44,8 @@ from bevy_ggrs_tpu.session.common import (
     SessionEvent,
     SessionState,
     NULL_FRAME,
+    restore_spans,
+    serialize_spans,
 )
 from bevy_ggrs_tpu.native.core import (
     NEVER_DISCONNECTED,
@@ -340,23 +342,18 @@ class P2PSession:
         boundaries (after ``handle_requests``), like CheckpointManager
         does."""
         lo = max(0, self.current_frame - self._CKPT_PROBE)
-        inputs: Dict[str, Dict[str, list]] = {}
-        queue_meta: Dict[str, Dict] = {}
-        for h, q in enumerate(self._queues):
-            per: Dict[str, list] = {}
-            for f in range(lo, q.last_confirmed_frame + 1):
-                got = q.confirmed(f)
-                if got is not None:
-                    per[str(f)] = np.asarray(got).tolist()
-            inputs[str(h)] = per
-            # Confirmed frontier + prediction source survive even when the
-            # span itself fell outside the probe window (long-disconnected
-            # players): the restored queue must keep predicting the FROZEN
-            # last input, not zeros, or survivors desync.
-            queue_meta[str(h)] = {
+        inputs = serialize_spans(self._queues, lo)
+        # Confirmed frontier + prediction source survive even when the
+        # span itself fell outside the probe window (long-disconnected
+        # players): the restored queue must keep predicting the FROZEN
+        # last input, not zeros, or survivors desync.
+        queue_meta: Dict[str, Dict] = {
+            str(h): {
                 "last_confirmed": int(q.last_confirmed_frame),
                 "last_input": np.asarray(q.last_input).tolist(),
             }
+            for h, q in enumerate(self._queues)
+        }
         used: Dict[str, list] = {}
         for f in range(lo, self.current_frame):
             got = self._tracker.get_used(f)
@@ -395,25 +392,13 @@ class P2PSession:
                 np.asarray(bits, dtype=dtype).reshape((self.num_players,) + shape),
                 np.asarray(status, np.int32),
             )
-        for h, q in enumerate(self._queues):
-            per = sd["inputs"].get(str(h), {})
-            meta = sd.get("queue_meta", {}).get(str(h), {})
-            frames = sorted(int(f) for f in per)
-            last = meta.get("last_input")
-            if last is not None:
-                last = np.asarray(last, dtype=dtype).reshape(shape)
-            if frames:
-                q.reset(frames[0], last)
-                for f in frames:
-                    arr = np.asarray(per[str(f)], dtype=dtype).reshape(shape)
-                    q.add_input(f, arr)
-                    # Re-derive pending mispredictions vs the used records.
-                    self._tracker.note_confirmed(h, f, arr)
-            else:
-                # No surviving span (player dead long before the
-                # checkpoint): restore the confirmed frontier + frozen
-                # prediction source directly.
-                q.reset(int(meta.get("last_confirmed", -1)) + 1, last)
+        # Re-derive pending mispredictions vs the used records while
+        # replaying each confirmed input.
+        restore_spans(
+            self._queues, sd["inputs"], self.current_frame, dtype, shape,
+            meta=sd.get("queue_meta"),
+            on_confirmed=self._tracker.note_confirmed,
+        )
         self._disconnected = {
             int(h): int(f) for h, f in sd["disconnected"].items()
         }

@@ -28,6 +28,8 @@ from bevy_ggrs_tpu.session.common import (
     SessionEvent,
     SessionState,
     NULL_FRAME,
+    restore_spans,
+    serialize_spans,
 )
 from bevy_ggrs_tpu.native.core import make_queue_set
 from bevy_ggrs_tpu.session.endpoint import PeerEndpoint, PeerState
@@ -61,6 +63,10 @@ class SpectatorSession:
         self._endpoint = PeerEndpoint(host_addr, rng)
         self.current_frame = 0
         self._events: List[SessionEvent] = []
+        # Consecutive polls whose input messages all started AHEAD of our
+        # confirmed frontier: the host has trimmed past us (stale-checkpoint
+        # resume) and the gap will never close.
+        self._gap_polls = 0
 
     # ------------------------------------------------------------------
 
@@ -115,6 +121,14 @@ class SpectatorSession:
         if not 0 <= h < self.num_players:
             return
         queue = self._queues[h]
+        if msg.start_frame > queue.last_confirmed_frame + 1:
+            # Span starts past our frontier. Transiently possible only if
+            # reordering outran the redundant resend; persistently it means
+            # the host trimmed history we never received (a checkpoint
+            # staler than the host's retained window) — count it so
+            # advance_frame can fail loudly instead of stalling forever.
+            self._gap_polls += 1
+            return
         for frame, bits in proto.unpack_input_span(
             msg, np.dtype(self._zero.dtype), self._zero.shape
         ):
@@ -123,6 +137,31 @@ class SpectatorSession:
             if frame != queue.last_confirmed_frame + 1:
                 break  # gap: wait for the redundant resend
             queue.add_input(frame, bits)
+            self._gap_polls = 0
+
+    # ------------------------------------------------------------------
+    # Checkpoint / resume
+
+    def state_dict(self) -> dict:
+        """Resumable local state: frame counter + buffered confirmed spans.
+
+        Contract (narrower than the P2P host's): a restored spectator can
+        only rejoin while the HOST still buffers inputs past this
+        checkpoint's frontier — i.e. resume from the NEWEST checkpoint,
+        promptly. Everything the spectator acked after this checkpoint was
+        trimmed host-side and is unrecoverable; in that case
+        ``advance_frame`` raises :class:`NotSynchronized` with an
+        unbridgeable-gap message (instead of stalling silently) and the
+        right move is to rejoin as a fresh spectator."""
+        inputs = serialize_spans(self._queues, max(0, self.current_frame - 4))
+        return {"current_frame": self.current_frame, "inputs": inputs}
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.current_frame = int(sd["current_frame"])
+        restore_spans(
+            self._queues, sd["inputs"], self.current_frame,
+            self._zero.dtype, self._zero.shape,
+        )
 
     # ------------------------------------------------------------------
 
@@ -140,6 +179,13 @@ class SpectatorSession:
             raise NotSynchronized("spectator has not synchronized with host")
         confirmed = self._confirmed_frame()
         if confirmed < self.current_frame:
+            if self._gap_polls > 120:
+                raise NotSynchronized(
+                    "confirmed-input stream has an unbridgeable gap (the "
+                    "host no longer retains frames past our frontier — "
+                    "e.g. a resume from a checkpoint older than the host's "
+                    "buffered window); rejoin as a fresh spectator"
+                )
             raise PredictionThreshold(
                 f"waiting for host input for frame {self.current_frame}"
             )

@@ -22,6 +22,8 @@ from bevy_ggrs_tpu.session.common import (
     InvalidRequest,
     MismatchedChecksum,
     SessionState,
+    restore_spans,
+    serialize_spans,
 )
 from bevy_ggrs_tpu.native.core import make_queue_set
 from bevy_ggrs_tpu.session.requests import AdvanceFrame, LoadGameState, SaveGameState
@@ -113,15 +115,9 @@ class SyncTestSession:
         queue's own confirmed horizon — with ``input_delay`` > 0 that
         horizon runs ``delay`` frames past ``current_frame`` (in-flight
         delayed inputs), which a frame-window capture would drop."""
-        inputs: Dict[str, Dict[str, list]] = {}
-        lo = max(0, self.current_frame - self.check_distance - 1)
-        for h, q in enumerate(self._queues):
-            per: Dict[str, list] = {}
-            for f in range(lo, q.last_confirmed_frame + 1):
-                got = q.confirmed(f)
-                if got is not None:
-                    per[str(f)] = np.asarray(got).tolist()
-            inputs[str(h)] = per
+        inputs = serialize_spans(
+            self._queues, max(0, self.current_frame - self.check_distance - 1)
+        )
         return {
             "current_frame": self.current_frame,
             "inputs": inputs,
@@ -135,13 +131,11 @@ class SyncTestSession:
         path (delay was already applied before capture), so the next forced
         rollback resimulates with exactly the original inputs."""
         self.current_frame = int(sd["current_frame"])
-        dtype = np.dtype(self.input_spec.zeros_np(1).dtype)
-        for h, q in enumerate(self._queues):
-            per = sd["inputs"].get(str(h), {})
-            frames = sorted(int(f) for f in per)
-            q.reset(frames[0] if frames else self.current_frame)
-            for f in frames:
-                q.add_input(f, np.asarray(per[str(f)], dtype=dtype))
+        zero = self.input_spec.zeros_np(1)[0]
+        restore_spans(
+            self._queues, sd["inputs"], self.current_frame,
+            zero.dtype, zero.shape,
+        )
         self._checksums = {int(f): int(c) for f, c in sd["checksums"].items()}
         self._pending.clear()
 

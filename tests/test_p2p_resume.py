@@ -182,3 +182,186 @@ def test_resume_with_dead_player_does_not_block_sync(tmp_path):
     assert run_a2.frame > crash_frame
     frames, pairs = common_confirmed_checksums([(sess_a2, run_a2), (sb, rb)])
     assert frames and all(a == b for a, b in pairs)
+
+
+def test_spectator_crash_restore(tmp_path):
+    """A crashed spectator restores from its newest checkpoint, re-syncs
+    with the host, and continues consuming the confirmed stream (the
+    host's unacked redundant resend bridges the crash gap because nothing
+    past the checkpoint was ever acked)."""
+    net = LoopbackNetwork(latency=1 * FPS_DT, seed=8)
+    clock = lambda: net.now
+
+    def host_peer(me):
+        builder = (
+            SessionBuilder(box_game.INPUT_SPEC)
+            .with_num_players(2)
+            .with_max_prediction_window(MAXPRED)
+        )
+        for h in range(2):
+            builder.add_player(
+                PlayerType.local() if h == me else
+                PlayerType.remote(("peer", h)), h)
+        if me == 0:
+            builder.add_player(PlayerType.spectator(("spec", 0)), 2)
+        sock = net.socket(("peer", me))
+        session = builder.start_p2p_session(sock, clock=clock)
+        runner = RollbackRunner(
+            box_game.make_schedule(), box_game.make_world(2).commit(),
+            max_prediction=MAXPRED, num_players=2,
+            input_spec=box_game.INPUT_SPEC)
+        return session, runner
+
+    def make_spec():
+        sock = net.socket(("spec", 0))
+        session = (
+            SessionBuilder(box_game.INPUT_SPEC)
+            .with_num_players(2)
+            .start_spectator_session(("peer", 0), sock, clock=clock)
+        )
+        runner = RollbackRunner(
+            box_game.make_schedule(), box_game.make_world(2).commit(),
+            max_prediction=MAXPRED, num_players=2,
+            input_spec=box_game.INPUT_SPEC)
+        return session, runner, sock
+
+    sess_a, run_a = host_peer(0)
+    sess_b, run_b = host_peer(1)
+    spec, spec_run, spec_sock = make_spec()
+    ckpt = str(tmp_path / "spec.npz")
+
+    def tick_spec():
+        spec.poll_remote_clients()
+        if spec.current_state() != SessionState.RUNNING:
+            return
+        try:
+            reqs = spec.advance_frame()
+        except PredictionThreshold:
+            return
+        spec_run.handle_requests(reqs, None)
+
+    for _ in range(60):
+        net.advance(FPS_DT)
+        tick(net, sess_a, run_a)
+        tick(net, sess_b, run_b)
+        tick_spec()
+    assert spec_run.frame > 20
+    save_runner(ckpt, spec_run, session=spec)
+    crash_frame = spec_run.frame
+
+    spec_sock.close()
+    del spec, spec_run
+    for _ in range(30):
+        net.advance(FPS_DT)
+        tick(net, sess_a, run_a)
+        tick(net, sess_b, run_b)
+
+    spec2, spec_run2, _ = make_spec()
+    restore_runner(ckpt, spec_run2, session=spec2)
+    spec, spec_run = spec2, spec_run2
+    for _ in range(200):
+        net.advance(FPS_DT)
+        tick(net, sess_a, run_a)
+        tick(net, sess_b, run_b)
+        tick_spec()
+    assert spec_run.frame > crash_frame + 20
+    assert spec.frames_behind_host() < 60
+    # The restored spectator's world must equal straight-line simulation of
+    # the (fully confirmed, deterministic) input script — a wrong-handle or
+    # wrong-frame restore would diverge here.
+    from bevy_ggrs_tpu.schedule import make_inputs
+    from bevy_ggrs_tpu.state import checksum
+
+    sched = box_game.make_schedule()
+    oracle = box_game.make_world(2).commit()
+    for f in range(spec_run.frame):
+        bits = np.asarray([scripted_input(h, f) for h in range(2)], np.uint8)
+        oracle = sched(oracle, make_inputs(bits))
+    assert int(checksum(spec_run.state)) == int(checksum(oracle))
+
+
+def test_spectator_stale_checkpoint_fails_loudly(tmp_path):
+    """Restoring a checkpoint OLDER than the spectator's last ack leaves an
+    unbridgeable gap (the host trimmed those frames on ack); the session
+    must raise NotSynchronized with a rejoin message instead of stalling
+    silently forever."""
+    import pytest
+
+    from bevy_ggrs_tpu.session import NotSynchronized
+
+    net = LoopbackNetwork(latency=1 * FPS_DT, seed=9)
+    clock = lambda: net.now
+
+    def host_peer(me):
+        builder = (
+            SessionBuilder(box_game.INPUT_SPEC)
+            .with_num_players(2)
+            .with_max_prediction_window(MAXPRED)
+        )
+        for h in range(2):
+            builder.add_player(
+                PlayerType.local() if h == me else
+                PlayerType.remote(("peer", h)), h)
+        if me == 0:
+            builder.add_player(PlayerType.spectator(("spec", 0)), 2)
+        session = builder.start_p2p_session(net.socket(("peer", me)),
+                                            clock=clock)
+        runner = RollbackRunner(
+            box_game.make_schedule(), box_game.make_world(2).commit(),
+            max_prediction=MAXPRED, num_players=2,
+            input_spec=box_game.INPUT_SPEC)
+        return session, runner
+
+    def make_spec():
+        sock = net.socket(("spec", 0))
+        session = (
+            SessionBuilder(box_game.INPUT_SPEC)
+            .with_num_players(2)
+            .start_spectator_session(("peer", 0), sock, clock=clock)
+        )
+        runner = RollbackRunner(
+            box_game.make_schedule(), box_game.make_world(2).commit(),
+            max_prediction=MAXPRED, num_players=2,
+            input_spec=box_game.INPUT_SPEC)
+        return session, runner, sock
+
+    sess_a, run_a = host_peer(0)
+    sess_b, run_b = host_peer(1)
+    spec, spec_run, spec_sock = make_spec()
+    ckpt = str(tmp_path / "stale.npz")
+    saved = [False]
+
+    def tick_spec():
+        spec.poll_remote_clients()
+        if spec.current_state() != SessionState.RUNNING:
+            return
+        try:
+            reqs = spec.advance_frame()
+        except PredictionThreshold:
+            return
+        spec_run.handle_requests(reqs, None)
+
+    for i in range(120):
+        net.advance(FPS_DT)
+        tick(net, sess_a, run_a)
+        tick(net, sess_b, run_b)
+        tick_spec()
+        # STALE checkpoint: taken early, then the spectator keeps acking
+        # another ~80 frames before crashing.
+        if not saved[0] and spec_run.frame > 15:
+            save_runner(ckpt, spec_run, session=spec)
+            saved[0] = True
+    assert saved[0] and spec_run.frame > 60
+
+    spec_sock.close()
+    del spec, spec_run
+    spec, spec_run, _ = make_spec()
+    restore_runner(ckpt, spec_run, session=spec)
+
+    with pytest.raises(NotSynchronized, match="unbridgeable gap"):
+        for _ in range(400):
+            net.advance(FPS_DT)
+            tick(net, sess_a, run_a)
+            tick(net, sess_b, run_b)
+            tick_spec()
+        raise AssertionError("stale-checkpoint stall was never detected")
