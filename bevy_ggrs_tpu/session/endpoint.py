@@ -65,6 +65,9 @@ class PeerEndpoint:
 
         # Outgoing input spans, per local handle: frame -> bits (unacked).
         self._pending_output: Dict[int, Dict[int, np.ndarray]] = {}
+        # Highest frame actually TRANSMITTED per handle: bounds acceptable
+        # acks (a peer cannot have received what we never sent).
+        self._max_sent: Dict[int, int] = {}
         # Handles we relay on behalf of a disconnected peer: the generic
         # piggybacked ack in InputMsg covers only the sender's OWN handles,
         # so relayed handles are trimmed exclusively by explicit InputAcks.
@@ -200,6 +203,11 @@ class PeerEndpoint:
         pending = self._pending_output.get(handle)
         if pending is None:
             return
+        # A peer cannot legitimately ack frames we never TRANSMITTED: a
+        # lying ack-ahead (buggy peer or source spoof) would otherwise trim
+        # input history before its first send and permanently stall the
+        # session. Clamp to the transmitted frontier.
+        ack_frame = min(ack_frame, self._max_sent.get(handle, -1))
         for f in [f for f in pending if f <= ack_frame]:
             del pending[f]
 
@@ -239,6 +247,9 @@ class PeerEndpoint:
                         advantage=local_advantage,
                     ),
                     now,
+                )
+                self._max_sent[handle] = max(
+                    self._max_sent.get(handle, -1), chunk[-1]
                 )
 
     def force_disconnect(self) -> None:

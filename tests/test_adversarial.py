@@ -1,0 +1,139 @@
+"""Session-level robustness against hostile datagrams.
+
+`protocol.decode` fuzzing (test_protocol_fuzz) covers parse safety; this
+covers SEMANTIC hostility: well-formed messages with malicious contents —
+out-of-range handles, absurd frames, lying span lengths and acks,
+checksum bombs.
+
+Threat model (same as the reference's ggrs): the transport is
+unauthenticated UDP. Datagrams from UNKNOWN addresses must be completely
+inert. Datagrams spoofing a REAL peer's source address are
+indistinguishable from that peer's own traffic — a full spoofer can forge
+inputs or acks outright, which no unauthenticated protocol can survive
+(runs needing that guarantee must wrap the transport in an authenticated
+channel) — so for peer-spoofed garbage the guaranteed properties are: no
+exception ever escapes, and the session object stays usable. One concrete
+defense IS enforced and tested: a peer acking AHEAD of what it was ever
+offered (lying or buggy) cannot trick us into trimming unsent input
+history, which would otherwise stall the victim permanently.
+"""
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu.session import (
+    EventKind,
+    PredictionThreshold,
+    SessionState,
+    protocol as proto,
+)
+from bevy_ggrs_tpu.transport.loopback import LoopbackNetwork
+
+from tests.test_p2p import (
+    FPS_DT,
+    common_confirmed_checksums,
+    make_pair,
+    scripted_input,
+)
+
+HOSTILE = [
+    proto.InputMsg(handle=250, start_frame=0, payload=b"\x01" * 8, num=8,
+                   ack_frame=0, sender_frame=0, advantage=0),
+    proto.InputMsg(handle=0, start_frame=2**31 - 2, payload=b"\x02", num=1,
+                   ack_frame=2**31 - 2, sender_frame=2**31 - 2, advantage=0),
+    proto.InputMsg(handle=1, start_frame=-5000, payload=b"\x03" * 4, num=4,
+                   ack_frame=-1, sender_frame=-1, advantage=-30000),
+    # num lies about the payload size (unpacker must stop at the data).
+    proto.InputMsg(handle=1, start_frame=5, payload=b"\x04", num=60000,
+                   ack_frame=0, sender_frame=5, advantage=0),
+    proto.InputAck(handle=200, ack_frame=2**31 - 1),
+    proto.ChecksumReport(frame=2**30, checksum=0xDEADBEEF),
+    proto.ChecksumReport(frame=-7, checksum=0),
+    proto.QualityReport(send_time_ms=2**32 - 1, frame_advantage=-32768),
+    proto.SyncReply(nonce=0x41414141),
+]
+
+
+def _drive(net, peers, n, hostile_from=None):
+    events = []
+    for i in range(n):
+        net.advance(FPS_DT)
+        if hostile_from is not None:
+            for msg in HOSTILE[i % len(HOSTILE):][:2]:
+                net._send(hostile_from, ("peer", 0), proto.encode(msg))
+                net._send(hostile_from, ("peer", 1), proto.encode(msg))
+        for session, runner in peers:
+            session.poll_remote_clients()
+            events.extend(session.events())
+            if session.current_state() != SessionState.RUNNING:
+                continue
+            for h in session.local_player_handles():
+                session.add_local_input(h, scripted_input(h, session.current_frame))
+            try:
+                requests = session.advance_frame()
+            except PredictionThreshold:
+                continue
+            runner.handle_requests(requests, session)
+    return events
+
+
+def test_unknown_address_hostility_is_inert():
+    """Garbage from a non-peer address: full progress, full agreement, no
+    desync events — exactly as if the intruder didn't exist."""
+    net = LoopbackNetwork(latency=1 * FPS_DT, seed=3)
+    peers = make_pair(net)
+    events = _drive(net, peers, 90, hostile_from=("intruder", 9))
+    (sa, ra), (sb, rb) = peers
+    assert ra.frame > 40 and rb.frame > 40
+    frames, pairs = common_confirmed_checksums(peers)
+    assert frames and all(a == b for a, b in pairs)
+    assert not any(e.kind == EventKind.DESYNC_DETECTED for e in events)
+
+
+def test_peer_spoofed_hostility_never_raises():
+    """Source-spoofed garbage claiming to be a peer: the protocol cannot
+    authenticate it away (threat-model note in the module docstring), but
+    nothing may crash and the sessions must stay usable."""
+    net = LoopbackNetwork(latency=1 * FPS_DT, seed=3)
+    peers = make_pair(net)
+    # Spoof as peer 1 toward both; everything must be absorbed silently.
+    _drive(net, peers, 90, hostile_from=("peer", 1))
+    for session, runner in peers:
+        session.events()
+        session.current_state()
+        for h in session.remote_player_handles():
+            session.network_stats(h)
+
+
+def test_lying_ack_ahead_cannot_stall_the_victim():
+    """A peer (or spoofer) acking frames never offered must not trim the
+    victim's unsent history: the clamped ack keeps the genuine resend
+    flowing and the pair progresses normally."""
+    net = LoopbackNetwork(latency=1 * FPS_DT, seed=5)
+    peers = make_pair(net)
+    lying_ack = proto.InputAck(handle=0, ack_frame=2**31 - 1)
+    lying_ack1 = proto.InputAck(handle=1, ack_frame=2**31 - 1)
+    events = []
+    for i in range(90):
+        net.advance(FPS_DT)
+        # Both peers constantly receive ack-ahead lies for every handle.
+        net._send(("peer", 1), ("peer", 0), proto.encode(lying_ack))
+        net._send(("peer", 1), ("peer", 0), proto.encode(lying_ack1))
+        net._send(("peer", 0), ("peer", 1), proto.encode(lying_ack))
+        net._send(("peer", 0), ("peer", 1), proto.encode(lying_ack1))
+        for session, runner in peers:
+            session.poll_remote_clients()
+            events.extend(session.events())
+            if session.current_state() != SessionState.RUNNING:
+                continue
+            for h in session.local_player_handles():
+                session.add_local_input(h, scripted_input(h, session.current_frame))
+            try:
+                requests = session.advance_frame()
+            except PredictionThreshold:
+                continue
+            runner.handle_requests(requests, session)
+    (sa, ra), (sb, rb) = peers
+    assert ra.frame > 40 and rb.frame > 40, "ack-ahead lie stalled the pair"
+    frames, pairs = common_confirmed_checksums(peers)
+    assert frames and all(a == b for a, b in pairs)
