@@ -365,3 +365,65 @@ def test_spectator_stale_checkpoint_fails_loudly(tmp_path):
             tick(net, sess_b, run_b)
             tick_spec()
         raise AssertionError("stale-checkpoint stall was never detected")
+
+
+def test_speculative_runner_survives_restore(tmp_path):
+    """Crash recovery with speculation enabled: the restored runner's
+    speculation state (input log, pending rollout) is empty, so it must
+    fall back to serial recoveries gracefully, rebuild its log as frames
+    advance, and keep both live peers in bitwise agreement after the
+    resume."""
+    from bevy_ggrs_tpu.spec_runner import SpeculativeRollbackRunner
+
+    def build_spec_runner():
+        return SpeculativeRollbackRunner(
+            box_game.make_schedule(), box_game.make_world(2).commit(),
+            max_prediction=MAXPRED, num_players=2,
+            input_spec=box_game.INPUT_SPEC, num_branches=16, spec_frames=8,
+        )
+
+    net = LoopbackNetwork(latency=2 * FPS_DT, seed=31)
+    clock = lambda: net.now
+    sess_a, _discard, _ = build_peer(net, 0, clock)
+    run_a = build_spec_runner()
+    sess_b, run_b, _ = build_peer(net, 1, clock)
+    ckpt = str(tmp_path / "specrun.npz")
+
+    def drive(n):
+        for _ in range(n):
+            net.advance(FPS_DT)
+            for s, r in ((sess_a, run_a), (sess_b, run_b)):
+                s.poll_remote_clients()
+                s.events()
+                if s.current_state() != SessionState.RUNNING:
+                    continue
+                for h in s.local_player_handles():
+                    s.add_local_input(h, scripted_input(h, s.current_frame))
+                try:
+                    reqs = s.advance_frame()
+                except PredictionThreshold:
+                    continue
+                r.handle_requests(reqs, s)
+                if hasattr(r, "speculate"):
+                    r.speculate(s.confirmed_frame(), s)
+
+    drive(50)
+    save_runner(ckpt, run_a, session=sess_a)
+    sess_a.socket.close()
+
+    builder = (
+        SessionBuilder(box_game.INPUT_SPEC)
+        .with_num_players(2)
+        .with_max_prediction_window(MAXPRED)
+    )
+    builder.add_player(PlayerType.local(), 0)
+    builder.add_player(PlayerType.remote(("peer", 1)), 1)
+    sess_a = builder.start_p2p_session(net.socket(("peer", 0)), clock=clock)
+    run_a = build_spec_runner()
+    restore_runner(ckpt, run_a, session=sess_a)
+    drive(150)
+
+    assert run_b.frame > 100  # joint progress after the crash
+    frames, pairs = common_confirmed_checksums([(sess_a, run_a),
+                                                (sess_b, run_b)])
+    assert frames and all(a == b for a, b in pairs)
