@@ -78,9 +78,35 @@ def scripted_input(handle: int, app) -> np.uint8:
 
 
 def print_events_system(app) -> None:
-    """`print_events_system` analog (`box_game_p2p.rs:107-111`)."""
+    """`print_events_system` analog (`box_game_p2p.rs:107-111`), upgraded:
+    a desync event immediately prints the per-component checksum breakdown
+    of the CURRENT state so both sides can diff and name the diverging
+    registered type (divergence is non-determinism — it persists, so the
+    live state localizes it even after the exact frame left the ring)."""
+    from bevy_ggrs_tpu.session.common import EventKind
+
     for event in app.events:
         print(f"[event] {event.kind.value} addr={event.addr} data={event.data}")
+        if event.kind == EventKind.DESYNC_DETECTED:
+            # Prefer the ring snapshot of the exact divergent frame (both
+            # peers then hash the SAME frame, so only diverging types
+            # differ); fall back to the live state when the slot rotated
+            # out — divergence persists, but frame-dependent parts will
+            # then differ too.
+            frame = (event.data or {}).get("frame")
+            parts = None
+            if frame is not None:
+                parts = app.stage.runner.diagnose_frame(frame)
+            which = f"frame {frame} snapshot"
+            if parts is None:
+                from bevy_ggrs_tpu.state import checksum_breakdown
+
+                parts = checksum_breakdown(app.stage.runner.state)
+                which = "live state (divergent frame left the ring)"
+            print(f"[desync diagnosis] per-part checksums of {which} "
+                  "(diff against the other peer's):")
+            for name, cs in sorted(parts.items()):
+                print(f"  {name}: {cs:#010x}")
     app.events.clear()
 
 

@@ -393,21 +393,13 @@ def test_speculative_runner_survives_restore(tmp_path):
         for _ in range(n):
             net.advance(FPS_DT)
             for s, r in ((sess_a, run_a), (sess_b, run_b)):
-                s.poll_remote_clients()
-                s.events()
-                if s.current_state() != SessionState.RUNNING:
-                    continue
-                for h in s.local_player_handles():
-                    s.add_local_input(h, scripted_input(h, s.current_frame))
-                try:
-                    reqs = s.advance_frame()
-                except PredictionThreshold:
-                    continue
-                r.handle_requests(reqs, s)
-                if hasattr(r, "speculate"):
+                tick(net, s, r)
+                if (hasattr(r, "speculate")
+                        and s.current_state() == SessionState.RUNNING):
                     r.speculate(s.confirmed_frame(), s)
 
-    drive(50)
+    drive(60)
+    assert run_a.frame > 30, "handshake too slow: checkpoint would be empty"
     save_runner(ckpt, run_a, session=sess_a)
     sess_a.socket.close()
 
