@@ -116,11 +116,15 @@ class SpeculativeRollbackRunner(RollbackRunner):
     """Drop-in :class:`RollbackRunner` that precomputes rollback recoveries.
 
     Extra knobs: ``num_branches`` (candidate futures per rollout),
-    ``sampler`` (branch enumeration policy, default the sticky bitmask
-    tree), ``spec_frames`` (rollout depth, default ``max_prediction``).
-    Call :meth:`speculate` once per tick after ``handle_requests`` with the
-    session's confirmed frame. Hit/miss counts land in ``spec_hits`` /
-    ``spec_misses`` and the metrics sink.
+    ``sampler`` (branch enumeration policy — None selects the structured
+    single-change tree with known-input pinning for scalar inputs, the
+    sticky random bitmask tree otherwise), ``branch_values`` (the candidate
+    input values the structured tree enumerates, default 0..15),
+    ``spec_frames`` (rollout depth, default ``max_prediction``). Call
+    :meth:`speculate(confirmed_frame, session)` once per tick after
+    ``handle_requests``. Counters: ``spec_hits``, ``spec_partial_hits``,
+    ``spec_misses``, ``rollback_frames_recovered_total``, plus the metrics
+    sink.
     """
 
     def __init__(
