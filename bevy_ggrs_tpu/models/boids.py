@@ -43,14 +43,17 @@ INPUT_SPEC = InputSpec(shape=(), dtype=jnp.uint8)
 # Flocking parameters (2D plane).
 NEIGHBOR_RADIUS = 1.0
 SEPARATION_RADIUS = 0.35
-W_SEPARATION = jnp.float32(0.08)
-W_ALIGNMENT = jnp.float32(0.05)
-W_COHESION = jnp.float32(0.03)
-W_LEADER = jnp.float32(0.06)
-LEADER_STEER = jnp.float32(0.02)
-MAX_SPEED = jnp.float32(0.08)
-MIN_SPEED = jnp.float32(0.02)
-WORLD_HALF = jnp.float32(8.0)
+# np scalars, not jnp: importing this module must not execute a JAX op
+# (backend selection may not have happened yet — e.g. the multichip dryrun
+# rebuilds a virtual CPU mesh before touching any model).
+W_SEPARATION = np.float32(0.08)
+W_ALIGNMENT = np.float32(0.05)
+W_COHESION = np.float32(0.03)
+W_LEADER = np.float32(0.06)
+LEADER_STEER = np.float32(0.02)
+MAX_SPEED = np.float32(0.08)
+MIN_SPEED = np.float32(0.02)
+WORLD_HALF = np.float32(8.0)
 
 
 def make_registry() -> TypeRegistry:

@@ -54,10 +54,13 @@ ACT_DIM = 4
 # to this many players (validated in make_world).
 MAX_PLAYERS = 8
 
-TARGET_SPEED = jnp.float32(0.12)
-ACCEL_SCALE = jnp.float32(0.02)
-MAX_SPEED = jnp.float32(0.15)
-WORLD_HALF = jnp.float32(6.0)
+# np scalars, not jnp: importing this module must not execute a JAX op
+# (backend selection may not have happened yet — e.g. the multichip dryrun
+# rebuilds a virtual CPU mesh before touching any model).
+TARGET_SPEED = np.float32(0.12)
+ACCEL_SCALE = np.float32(0.02)
+MAX_SPEED = np.float32(0.15)
+WORLD_HALF = np.float32(6.0)
 
 
 def make_policy_params(seed: int = 0, hidden: int = HIDDEN):
