@@ -32,6 +32,7 @@ from bevy_ggrs_tpu.state import (
     ring_load,
     ring_frame_at,
     checksum,
+    combine64,
     to_host,
 )
 

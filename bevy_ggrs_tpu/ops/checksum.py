@@ -1,7 +1,8 @@
 """Pallas kernel for the order-insensitive world checksum.
 
-Computes bit-identically the same uint32 as :func:`bevy_ggrs_tpu.state.
-checksum` (the murmur3-style per-slot hash, wrapping-summed over live slots —
+Computes bit-identically the same two-lane ``uint32[2]`` checksum as
+:func:`bevy_ggrs_tpu.state.checksum` (the murmur3-style per-slot hash,
+wrapping-summed over live slots into 64 bits as [lo, hi] lanes —
 the vectorized form of the reference's ``checksum += component.reflect_hash()``
 at ``/root/reference/src/world_snapshot.rs:72-75``), but as ONE kernel pass:
 
@@ -32,6 +33,7 @@ from bevy_ggrs_tpu.state import WorldState
 # primitives, not copying them (both are plain jnp and lower inside kernels);
 # same for the unroll threshold the two chains must agree on.
 _SEED = state_lib._SEED
+_HI_TWEAK = state_lib._HI_TWEAK
 _mix_one = state_lib._mix_one
 _fmix = state_lib._fmix
 _UNROLL_LIMIT = state_lib._UNROLL_LIMIT
@@ -40,13 +42,19 @@ _LANE_BLOCK = 512
 
 
 def _hash_kernel(words_ref, alive_ref, out_ref, *, n_words: int):
-    """One slot block: chain-mix all ``n_words`` rows, fmix, masked-sum.
+    """One slot block: chain-mix all ``n_words`` rows into both checksum
+    lanes (lo/hi murmur streams from their own seeds — same word pass, two
+    integer chains), fmix, masked-sum per lane.
 
-    Each grid step writes its own partial sum (summed by XLA outside), so
+    Each grid step writes its own partial sums (summed by XLA outside), so
     there is no cross-step carry — which keeps the kernel vmap-safe for the
     speculative branch axis.
     """
-    h = jnp.full((1, words_ref.shape[1]), _SEED, dtype=jnp.uint32)
+    blk = words_ref.shape[1]
+    h = jnp.concatenate([
+        jnp.full((1, blk), _SEED, dtype=jnp.uint32),
+        jnp.full((1, blk), _SEED ^ _HI_TWEAK, dtype=jnp.uint32),
+    ])  # [2, blk]; each mixed word row broadcasts over the lane axis
     if n_words <= _UNROLL_LIMIT:
         for i in range(n_words):
             h = _mix_one(h, words_ref[i : i + 1, :])
@@ -61,7 +69,8 @@ def _hash_kernel(words_ref, alive_ref, out_ref, *, n_words: int):
     h = jnp.where(alive_ref[0:1, :] != 0, h, jnp.uint32(0))
     # Mosaic has no unsigned reductions; a wrapping int32 sum is bit-identical.
     h_i32 = jax.lax.bitcast_convert_type(h, jnp.int32)
-    out_ref[pl.program_id(0), 0] = jnp.sum(h_i32, dtype=jnp.int32)
+    out_ref[pl.program_id(0), 0] = jnp.sum(h_i32[0], dtype=jnp.int32)
+    out_ref[pl.program_id(0), 1] = jnp.sum(h_i32[1], dtype=jnp.int32)
 
 
 def _use_interpret() -> bool:
@@ -91,13 +100,14 @@ def _entity_hash_sum(
             pl.BlockSpec((1, blk), lambda i: (0, i)),
         ],
         out_specs=pl.BlockSpec(
-            (n_blocks, 1), lambda i: (0, 0), memory_space=pltpu.SMEM
+            (n_blocks, 2), lambda i: (0, 0), memory_space=pltpu.SMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((n_blocks, 1), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((n_blocks, 2), jnp.int32),
         interpret=interpret,
     )(words_t, alive_u32)
     return jnp.sum(
-        jax.lax.bitcast_convert_type(partials, jnp.uint32), dtype=jnp.uint32
+        jax.lax.bitcast_convert_type(partials, jnp.uint32), axis=0,
+        dtype=jnp.uint32,
     )
 
 

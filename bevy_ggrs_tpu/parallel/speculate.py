@@ -135,7 +135,8 @@ class SpecResult:
     """One speculative rollout: B branches × F frames from one start state.
 
     ``rings``/``states`` have a leading branch axis on every leaf;
-    ``checksums[B, F]`` is the per-branch stream of saved-frame checksums;
+    ``checksums[B, F, 2]`` is the per-branch stream of saved-frame two-lane
+    (lo/hi 64-bit) checksums;
     ``branch_bits`` is the input tensor that produced it (kept for
     :func:`match_branch`); ``start_frame`` labels the first saved frame.
     """
@@ -238,7 +239,7 @@ class SpeculativeExecutor:
             return SnapshotRing(
                 states=stacked,
                 frames=jnp.full((depth,), -1, dtype=jnp.int32),
-                checksums=jnp.zeros((depth,), dtype=jnp.uint32),
+                checksums=jnp.zeros((depth, 2), dtype=jnp.uint32),
             )
 
         mask = jnp.ones((max_frames,), dtype=jnp.bool_)
@@ -322,5 +323,5 @@ def merge_rings(main: SnapshotRing, spec: SnapshotRing) -> SnapshotRing:
     return SnapshotRing(
         states=jax.tree_util.tree_map(sel, spec.states, main.states),
         frames=jnp.where(take, spec.frames, main.frames),
-        checksums=jnp.where(take, spec.checksums, main.checksums),
+        checksums=jnp.where(take[:, None], spec.checksums, main.checksums),
     )

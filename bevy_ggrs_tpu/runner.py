@@ -32,7 +32,7 @@ import numpy as np
 from bevy_ggrs_tpu.rollout import RolloutExecutor
 from bevy_ggrs_tpu.schedule import Schedule
 from bevy_ggrs_tpu.session.requests import AdvanceFrame, LoadGameState, SaveGameState
-from bevy_ggrs_tpu.state import WorldState, ring_init, to_host
+from bevy_ggrs_tpu.state import WorldState, combine64, ring_init, to_host
 
 
 @dataclasses.dataclass
@@ -181,9 +181,9 @@ class RollbackRunner:
                 ]
                 if report:
                     with self.metrics.timer("checksum_sync"):
-                        cs_host = np.asarray(checksums)
+                        cs_host = np.asarray(checksums)  # [T, 2] lo/hi lanes
                     for t, sf in report:
-                        session.report_checksum(sf, int(cs_host[t]))
+                        session.report_checksum(sf, combine64(cs_host[t]))
         self.metrics.count("frames_advanced", sum(1 for s in steps if s.adv))
         if load_frame is not None:
             depth = sum(1 for s in steps if s.adv is not None)

@@ -49,8 +49,8 @@ class MismatchedChecksum(GGRSError):
 
     def __init__(self, frame: int, original: int, resimulated: int):
         super().__init__(
-            f"desync at frame {frame}: original checksum {original:#010x}, "
-            f"resimulated {resimulated:#010x}"
+            f"desync at frame {frame}: original checksum {original:#018x}, "
+            f"resimulated {resimulated:#018x}"
         )
         self.frame = frame
         self.original = original

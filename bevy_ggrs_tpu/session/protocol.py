@@ -20,7 +20,10 @@ from typing import List, Optional, Tuple, Union
 import numpy as np
 
 MAGIC = 0x47  # 'G'
-VERSION = 1
+# v2: ChecksumReport widened to 64 bits (the reference's saved-state cell is
+# u128-capable — ggrs_stage.rs:283; 32 bits collides too easily at one
+# compare per 16 confirmed frames). Version mismatch = datagram dropped.
+VERSION = 2
 
 T_SYNC_REQUEST = 1
 T_SYNC_REPLY = 2
@@ -116,7 +119,7 @@ Message = Union[
 ]
 
 _U32 = struct.Struct("<I")
-_I32U32 = struct.Struct("<iI")
+_I32U64 = struct.Struct("<iQ")
 _BI = struct.Struct("<Bi")
 _IH = struct.Struct("<Ih")
 
@@ -143,8 +146,8 @@ def encode(msg: Message) -> bytes:
     if isinstance(msg, KeepAlive):
         return _HDR.pack(MAGIC, VERSION, T_KEEP_ALIVE)
     if isinstance(msg, ChecksumReport):
-        return _HDR.pack(MAGIC, VERSION, T_CHECKSUM_REPORT) + _I32U32.pack(
-            msg.frame, msg.checksum & 0xFFFFFFFF
+        return _HDR.pack(MAGIC, VERSION, T_CHECKSUM_REPORT) + _I32U64.pack(
+            msg.frame, msg.checksum & 0xFFFFFFFFFFFFFFFF
         )
     raise TypeError(f"unknown message {msg!r}")
 
@@ -176,7 +179,7 @@ def decode(data: bytes) -> Optional[Message]:
         if mtype == T_KEEP_ALIVE:
             return KeepAlive()
         if mtype == T_CHECKSUM_REPORT:
-            f, cs = _I32U32.unpack_from(body)
+            f, cs = _I32U64.unpack_from(body)
             return ChecksumReport(f, cs)
         return None
     except struct.error:

@@ -280,7 +280,9 @@ _UNROLL_LIMIT = 64
 
 
 def _mix_words(h: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
-    """Murmur3-style streaming mix of ``words[cap, n]`` into ``h[cap]``.
+    """Murmur3-style streaming mix of ``words[cap, n]`` into ``h[cap]``
+    (or ``h[2, cap]`` — the two-lane checksum state broadcasts over the
+    leading axis).
 
     Small word counts unroll statically; large components (grids, big
     per-entity tensors) fall back to ``lax.scan`` over columns so trace size
@@ -305,18 +307,55 @@ def _fmix(h: jnp.ndarray) -> jnp.ndarray:
     return h
 
 
+# Seed separating the hi lane's mix stream from the lo lane's (golden-ratio
+# word, the usual choice for independent hash streams).
+#
+# The exchanged checksum is 64 bits wide (the reference's saved-state cell
+# carries u128 — ``ggrs_stage.rs:283``); on device (no uint64 without x64
+# mode) it is carried as two uint32 lanes. Each lane is a FULL murmur stream
+# over the same words from its own seed — NOT a re-finalization of the lo
+# hash, which (being a bijection of it) would collide whenever the lo hash
+# collides and leave single-slot divergence at 32-bit resistance. Both
+# streams mix in the same word pass (one memory traversal, two VPU integer
+# chains), so the cost is arithmetic only.
+_HI_TWEAK = np.uint32(0x9E3779B9)
+
+
+def _seed_rows(cap: int) -> jnp.ndarray:
+    """[2, cap] per-lane murmur seeds (lane 0 = lo, lane 1 = hi).
+
+    ``_mix_words``/``_mix_one`` broadcast over the leading lane axis
+    unchanged: each mixed word column has shape [cap] against state [2, cap].
+    """
+    return jnp.stack([
+        jnp.full((cap,), _SEED, dtype=jnp.uint32),
+        jnp.full((cap,), _SEED ^ _HI_TWEAK, dtype=jnp.uint32),
+    ])
+
+
+def combine64(cs) -> int:
+    """Host-side: fold a two-lane ``uint32[2]`` checksum into one Python int
+    (the value sessions exchange and compare)."""
+    a = np.asarray(cs, dtype=np.uint64).reshape(-1)
+    return int(a[0] | (a[1] << np.uint64(32)))
+
+
 def checksum(state: WorldState) -> jnp.ndarray:
-    """Order-insensitive uint32 checksum of the rollback domain.
+    """Order-insensitive 64-bit checksum of the rollback domain, as two
+    uint32 lanes ``[lo, hi]``.
 
     Per-slot: a murmur-style hash over ``rollback_id`` and every
     present component's words (order-sensitive *within* a slot). Slot hashes
     are wrapping-summed over live slots, so the result is independent of slot
     order — matching the reference's wrapping ``checksum +=
     component.reflect_hash()`` (``world_snapshot.rs:72-75``). Resource hashes
-    are mixed in the same way (``world_snapshot.rs:123-125``).
+    are mixed in the same way (``world_snapshot.rs:123-125``). The hi lane
+    is an independent murmur stream over the same words (see ``_HI_TWEAK``),
+    widening the exchanged value to 64 bits like the reference's u128-capable
+    cell (``ggrs_stage.rs:283``).
     """
     cap = state.capacity
-    h = jnp.full((cap,), _SEED, dtype=jnp.uint32)
+    h = _seed_rows(cap)  # [2, cap]: lo and hi lanes, mixed in one pass
     h = _mix_words(h, _to_u32_words(state.rollback_id))
     for name in sorted(state.components):
         words = _to_u32_words(state.components[name])
@@ -327,14 +366,19 @@ def checksum(state: WorldState) -> jnp.ndarray:
         h = _mix_words(h, state.present[name].astype(jnp.uint32).reshape(cap, 1))
         h = _mix_words(h, words)
     h = _fmix(h)
-    total = jnp.sum(jnp.where(state.alive, h, jnp.uint32(0)), dtype=jnp.uint32)
-    return total + _resources_checksum(state.resources)
+    lanes = jnp.sum(
+        jnp.where(state.alive[None, :], h, jnp.uint32(0)), axis=1,
+        dtype=jnp.uint32,
+    )
+    return lanes + _resources_checksum(state.resources)
 
 
 def _resources_checksum(resources: Dict[str, Any]) -> jnp.ndarray:
     """Order-sensitive resource hash stream, keyed by sorted name for
-    stability; shared by the XLA and Pallas checksum paths."""
-    total = jnp.uint32(0)
+    stability; shared by the XLA and Pallas checksum paths. Returns the
+    two-lane ``uint32[2]`` form (see :func:`checksum`): each lane is its own
+    murmur stream over the resource words from its own seed."""
+    total = jnp.zeros((2,), dtype=jnp.uint32)
     for name in sorted(resources):
         leaves = jax.tree_util.tree_leaves(resources[name])
         # Seed with the full name so same-length-named resources can't swap
@@ -342,11 +386,15 @@ def _resources_checksum(resources: Dict[str, Any]) -> jnp.ndarray:
         name_seed = 0
         for b in name.encode():
             name_seed = (name_seed * 31 + b) & 0xFFFFFFFF
-        rh = jnp.full((1,), _SEED ^ np.uint32(name_seed), dtype=jnp.uint32)
+        rh = jnp.array(
+            [_SEED ^ np.uint32(name_seed),
+             (_SEED ^ _HI_TWEAK) ^ np.uint32(name_seed)],
+            dtype=jnp.uint32,
+        )
         for leaf in leaves:
             words = _to_u32_words(jnp.atleast_1d(leaf).reshape(1, -1))
             rh = _mix_words(rh, words)
-        total = total + _fmix(rh)[0]
+        total = total + _fmix(rh)
     return total
 
 
@@ -364,12 +412,14 @@ def checksum_breakdown(state: WorldState) -> Dict[str, int]:
     cap = state.capacity
     out: Dict[str, int] = {}
 
-    def slot_sum(h):
+    def slot_sum(h):  # h [2, cap]
         h = _fmix(h)
-        return int(jnp.sum(jnp.where(state.alive, h, jnp.uint32(0)),
-                           dtype=jnp.uint32))
+        return combine64(jnp.sum(
+            jnp.where(state.alive[None, :], h, jnp.uint32(0)), axis=1,
+            dtype=jnp.uint32,
+        ))
 
-    h = jnp.full((cap,), _SEED, dtype=jnp.uint32)
+    h = _seed_rows(cap)
     out["rollback_id"] = slot_sum(_mix_words(h, _to_u32_words(state.rollback_id)))
     out["alive"] = slot_sum(
         _mix_words(h, state.alive.astype(jnp.uint32).reshape(cap, 1))
@@ -381,7 +431,7 @@ def checksum_breakdown(state: WorldState) -> Dict[str, int]:
         hh = _mix_words(h, pres.astype(jnp.uint32).reshape(cap, 1))
         out[f"component/{name}"] = slot_sum(_mix_words(hh, words))
     for name in sorted(state.resources):
-        out[f"resource/{name}"] = int(
+        out[f"resource/{name}"] = combine64(
             _resources_checksum({name: state.resources[name]})
         )
     return out
@@ -420,7 +470,7 @@ class SnapshotRing:
 
     states: WorldState  # every leaf gains a leading [depth] axis
     frames: jnp.ndarray  # int32[depth], -1 = empty
-    checksums: jnp.ndarray  # uint32[depth]
+    checksums: jnp.ndarray  # uint32[depth, 2] — [lo, hi] 64-bit lanes
 
     @property
     def depth(self) -> int:
@@ -435,7 +485,7 @@ def ring_init(state: WorldState, depth: int) -> SnapshotRing:
     return SnapshotRing(
         states=stacked,
         frames=jnp.full((depth,), -1, dtype=jnp.int32),
-        checksums=jnp.zeros((depth,), dtype=jnp.uint32),
+        checksums=jnp.zeros((depth, 2), dtype=jnp.uint32),
     )
 
 

@@ -106,7 +106,7 @@ def print_events_system(app) -> None:
             print(f"[desync diagnosis] per-part checksums of {which} "
                   "(diff against the other peer's):")
             for name, cs in sorted(parts.items()):
-                print(f"  {name}: {cs:#010x}")
+                print(f"  {name}: {cs:#018x}")
     app.events.clear()
 
 

@@ -40,7 +40,7 @@ def main() -> int:
     from bevy_ggrs_tpu.models import boids, neural_bots
     from bevy_ggrs_tpu.runner import RollbackRunner
     from bevy_ggrs_tpu.session import MismatchedChecksum, SyncTestSession
-    from bevy_ggrs_tpu.state import checksum
+    from bevy_ggrs_tpu.state import combine64, checksum
 
     if args.model == "boids":
         model = boids
@@ -80,7 +80,7 @@ def main() -> int:
           f"frame_count={fc} entities={args.entities} "
           f"rollbacks={runner.rollbacks_total} "
           f"resimulated={runner.rollback_frames_total} "
-          f"final_checksum={hex(int(checksum(runner.state)))}")
+          f"final_checksum={hex(combine64(checksum(runner.state)))}")
     inst.finish()
     return 0
 

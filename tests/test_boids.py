@@ -9,7 +9,7 @@ from bevy_ggrs_tpu.parallel.sharding import branch_mesh, shard_branch_axis, shar
 from bevy_ggrs_tpu.parallel.speculate import SpeculativeExecutor
 from bevy_ggrs_tpu.rollout import advance_n
 from bevy_ggrs_tpu.schedule import make_inputs
-from bevy_ggrs_tpu.state import checksum
+from bevy_ggrs_tpu.state import combine64, checksum
 
 
 def make_state(n=64, players=2, seed=0):
@@ -50,7 +50,7 @@ class TestFlocking:
         np.testing.assert_array_equal(
             np.asarray(a.components["position"]), np.asarray(b.components["position"])
         )
-        assert int(checksum(a)) == int(checksum(b))
+        assert combine64(checksum(a)) == combine64(checksum(b))
 
 
 class TestBoidsSyncTest:

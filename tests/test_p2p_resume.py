@@ -270,14 +270,14 @@ def test_spectator_crash_restore(tmp_path):
     # the (fully confirmed, deterministic) input script — a wrong-handle or
     # wrong-frame restore would diverge here.
     from bevy_ggrs_tpu.schedule import make_inputs
-    from bevy_ggrs_tpu.state import checksum
+    from bevy_ggrs_tpu.state import combine64, checksum
 
     sched = box_game.make_schedule()
     oracle = box_game.make_world(2).commit()
     for f in range(spec_run.frame):
         bits = np.asarray([scripted_input(h, f) for h in range(2)], np.uint8)
         oracle = sched(oracle, make_inputs(bits))
-    assert int(checksum(spec_run.state)) == int(checksum(oracle))
+    assert combine64(checksum(spec_run.state)) == combine64(checksum(oracle))
 
 
 def test_spectator_stale_checkpoint_fails_loudly(tmp_path):

@@ -12,7 +12,7 @@ import pytest
 from bevy_ggrs_tpu.models import box_game
 from bevy_ggrs_tpu.runner import RollbackRunner
 from bevy_ggrs_tpu.session import SyncTestSession
-from bevy_ggrs_tpu.state import checksum, ring_init, ring_save
+from bevy_ggrs_tpu.state import combine64, checksum, ring_init, ring_save
 from bevy_ggrs_tpu.utils.persistence import (
     CheckpointManager,
     load_checkpoint,
@@ -28,7 +28,7 @@ def test_world_state_round_trip_bitwise(tmp_path):
     save_checkpoint(p, state, {"note": "hello"})
     restored, meta = load_checkpoint(p, box_game.make_world(2).commit())
     assert meta == {"note": "hello"}
-    assert int(checksum(restored)) == int(checksum(state))
+    assert combine64(checksum(restored)) == combine64(checksum(state))
 
 
 def test_ring_round_trip(tmp_path):
@@ -39,7 +39,7 @@ def test_ring_round_trip(tmp_path):
     save_checkpoint(p, ring)
     restored, _ = load_checkpoint(p, ring_init(state, 4))
     assert int(restored.frames[2]) == 2
-    assert int(restored.checksums[2]) == int(cs)
+    assert combine64(restored.checksums[2]) == combine64(cs)
 
 
 def test_template_mismatch_rejected(tmp_path):
@@ -80,7 +80,7 @@ def _drive(session, runner, frames, seed_base=0, collect=None):
             session.add_local_input(h, np.uint8((seed_base + i + h) % 16))
         runner.handle_requests(session.advance_frame(), session)
         if collect is not None:
-            collect.append(int(checksum(runner.state)))
+            collect.append(combine64(checksum(runner.state)))
 
 
 def test_crash_recovery_resumes_bitwise(tmp_path):
@@ -158,7 +158,7 @@ def test_manager_rolls_and_restores(tmp_path):
     meta = mgr.restore_latest(fresh, session=fresh_sess)
     assert meta is not None and fresh.frame == 20
     assert fresh_sess.current_frame == session.current_frame
-    assert int(checksum(fresh.state)) == int(checksum(runner.state))
+    assert combine64(checksum(fresh.state)) == combine64(checksum(runner.state))
 
 
 def test_manager_restore_empty_dir(tmp_path):

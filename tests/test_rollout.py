@@ -10,7 +10,7 @@ identical to the reference's serial request loop
 import jax.numpy as jnp
 import numpy as np
 
-from bevy_ggrs_tpu import checksum, ring_init, ring_load, ring_save
+from bevy_ggrs_tpu import checksum, combine64, ring_init, ring_load, ring_save
 from bevy_ggrs_tpu.models import box_game
 from bevy_ggrs_tpu.rollout import RolloutExecutor, advance_n
 from bevy_ggrs_tpu.schedule import make_inputs
@@ -31,7 +31,7 @@ def serial_reference(sched, ring, state, start_frame, bits_seq):
     for bits in bits_seq:
         ring, cs = ring_save(ring, state, frame)
         state = sched(state, make_inputs(bits))
-        css.append(int(cs))
+        css.append(combine64(cs))
         frame += 1
     return ring, state, css
 
@@ -49,8 +49,8 @@ def test_burst_equals_serial():
     r1, s1, cs1 = ex.run(ring, state, 0, bits, status, n_frames=5)
     r2, s2, cs2 = serial_reference(sched, ring, state, 0, bits)
 
-    assert [int(c) for c in np.asarray(cs1)[:5]] == cs2
-    assert int(checksum(s1)) == int(checksum(s2))
+    assert [combine64(c) for c in np.asarray(cs1)[:5]] == cs2
+    assert combine64(checksum(s1)) == combine64(checksum(s2))
     np.testing.assert_array_equal(np.asarray(r1.frames), np.asarray(r2.frames))
     for f in range(5):
         np.testing.assert_array_equal(
@@ -67,7 +67,7 @@ def test_padding_steps_are_noops():
     # Only frames 0 and 1 saved; padding produced zero checksums and no writes.
     assert int(r.frames[0]) == 0 and int(r.frames[1]) == 1
     assert int(r.frames[2]) == -1
-    assert all(int(c) == 0 for c in np.asarray(cs)[2:])
+    assert all(combine64(c) == 0 for c in np.asarray(cs)[2:])
     assert int(s.resources["frame_count"]) == 2
 
 
@@ -91,14 +91,14 @@ def test_rollback_load_then_resimulate():
     oracle = state
     for bits in list(A[:2]) + list(B):
         oracle = sched(oracle, make_inputs(bits))
-    assert int(checksum(corrected)) == int(checksum(oracle))
+    assert combine64(checksum(corrected)) == combine64(checksum(oracle))
     assert int(corrected.resources["frame_count"]) == 5
     # Re-saved frames 2..4 must now hold the corrected timeline.
     resaved = ring_load(ring2, 3)
     oracle3 = state
     for bits in list(A[:2]) + [B[0]]:
         oracle3 = sched(oracle3, make_inputs(bits))
-    assert int(checksum(resaved)) == int(checksum(oracle3))
+    assert combine64(checksum(resaved)) == combine64(checksum(oracle3))
 
 
 def test_resimulation_checksums_match_original_when_inputs_agree():
@@ -113,7 +113,7 @@ def test_resimulation_checksums_match_original_when_inputs_agree():
         ring1, s1, 6, bits[2:], status[2:], n_frames=4, load_frame=2
     )
     np.testing.assert_array_equal(np.asarray(cs_resim)[:4], np.asarray(cs_orig)[2:6])
-    assert int(checksum(s1)) == int(checksum(s2))
+    assert combine64(checksum(s1)) == combine64(checksum(s2))
 
 
 def test_burst_too_long_raises():
@@ -134,4 +134,4 @@ def test_advance_n_matches_schedule_loop():
     oracle = state
     for b in bits:
         oracle = sched(oracle, make_inputs(b))
-    assert int(checksum(out)) == int(checksum(oracle))
+    assert combine64(checksum(out)) == combine64(checksum(oracle))

@@ -13,7 +13,7 @@ from bevy_ggrs_tpu.models import box_game
 from bevy_ggrs_tpu.parallel.sharding import branch_mesh
 from bevy_ggrs_tpu.runner import RollbackRunner
 from bevy_ggrs_tpu.session import SyncTestSession
-from bevy_ggrs_tpu.state import checksum
+from bevy_ggrs_tpu.state import combine64, checksum
 
 
 def _run(mesh):
@@ -30,7 +30,7 @@ def _run(mesh):
         for h in range(2):
             session.add_local_input(h, np.uint8(rng.randint(0, 16)))
         runner.handle_requests(session.advance_frame(), session)
-        cs.append(int(checksum(runner.state)))
+        cs.append(combine64(checksum(runner.state)))
     return runner, cs
 
 

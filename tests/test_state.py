@@ -15,6 +15,7 @@ from bevy_ggrs_tpu import (
     HostWorld,
     TypeRegistry,
     checksum,
+    combine64,
     init_state,
     ring_init,
     ring_load,
@@ -70,12 +71,12 @@ def test_capacity_exhaustion():
 def test_checksum_changes_with_state():
     reg = make_registry()
     state = make_world(reg).commit()
-    c0 = int(checksum(state))
+    c0 = combine64(checksum(state))
     moved = state.replace(
         components={**state.components,
                     "translation": state.components["translation"].at[0, 0].add(1.0)}
     )
-    assert int(checksum(moved)) != c0
+    assert combine64(checksum(moved)) != c0
 
 
 def test_checksum_order_insensitive():
@@ -89,7 +90,7 @@ def test_checksum_order_insensitive():
     b = HostWorld(reg, 8)
     b.spawn({"velocity": [4.0, 5.0, 6.0]}, rollback_id=9)
     b.spawn({"translation": [1.0, 2.0, 3.0]}, rollback_id=7)
-    assert int(checksum(a.commit())) == int(checksum(b.commit()))
+    assert combine64(checksum(a.commit())) == combine64(checksum(b.commit()))
 
 
 def test_checksum_ignores_dead_slot_garbage():
@@ -102,14 +103,14 @@ def test_checksum_ignores_dead_slot_garbage():
         components={**state.components,
                     "translation": state.components["translation"].at[3].set(99.0)}
     )
-    assert int(checksum(state)) == int(checksum(dirty))
+    assert combine64(checksum(state)) == combine64(checksum(dirty))
 
 
 def test_checksum_sees_resources():
     reg = make_registry()
     state = make_world(reg).commit()
     bumped = state.replace(resources={"frame_count": jnp.int32(5)})
-    assert int(checksum(state)) != int(checksum(bumped))
+    assert combine64(checksum(state)) != combine64(checksum(bumped))
 
 
 def test_checksum_distinguishes_present_from_default():
@@ -120,7 +121,7 @@ def test_checksum_distinguishes_present_from_default():
     a.spawn({"translation": [0.0, 0.0, 0.0]}, rollback_id=0)
     b = HostWorld(reg, 4)
     b.spawn({}, rollback_id=0)
-    assert int(checksum(a.commit())) != int(checksum(b.commit()))
+    assert combine64(checksum(a.commit())) != combine64(checksum(b.commit()))
 
 
 def test_ring_save_load_roundtrip():
@@ -129,7 +130,7 @@ def test_ring_save_load_roundtrip():
     ring = ring_init(state, depth=4)
     ring, cs = ring_save(ring, state, 0)
     assert int(ring.frames[0]) == 0
-    assert int(cs) == int(checksum(state))
+    assert combine64(cs) == combine64(checksum(state))
 
     moved = state.replace(
         components={**state.components,
@@ -183,8 +184,8 @@ def test_restore_reconciles_spawn_despawn():
     np.testing.assert_array_equal(
         np.asarray(restored.rollback_id), np.asarray(state.rollback_id)
     )
-    assert int(checksum(restored)) == int(checksum(state))
-    assert int(checksum(mutated)) != int(checksum(state))
+    assert combine64(checksum(restored)) == combine64(checksum(state))
+    assert combine64(checksum(mutated)) != combine64(checksum(state))
 
 
 def test_ring_ops_jittable():
@@ -198,7 +199,7 @@ def test_ring_ops_jittable():
         return ring_load(ring, frame), cs
 
     back, cs = save_then_load(ring, state, jnp.int32(2))
-    assert int(cs) == int(checksum(state))
+    assert combine64(cs) == combine64(checksum(state))
     np.testing.assert_array_equal(np.asarray(back.alive), np.asarray(state.alive))
 
 
@@ -206,7 +207,7 @@ def test_empty_registry_state():
     reg = TypeRegistry()
     state = init_state(reg, 4)
     assert int(state.num_alive()) == 0
-    int(checksum(state))  # must not crash on empty component/resource dicts
+    combine64(checksum(state))  # must not crash on empty component/resource dicts
 
 
 def test_checksum_breakdown_localizes_divergence():

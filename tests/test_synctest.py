@@ -10,7 +10,7 @@ Reference behavior: `examples/box_game/box_game_synctest.rs:27-38` +
 import numpy as np
 import pytest
 
-from bevy_ggrs_tpu import checksum
+from bevy_ggrs_tpu import checksum, combine64
 from bevy_ggrs_tpu.models import box_game
 from bevy_ggrs_tpu.runner import RollbackRunner
 from bevy_ggrs_tpu.schedule import make_inputs
@@ -86,7 +86,7 @@ def test_synctest_matches_straightline_simulation():
         bits = rng.randint(0, 16, size=2).astype(np.uint8)
         tick(session, runner, bits)
         oracle = sched(oracle, make_inputs(bits))
-    assert int(checksum(runner.state)) == int(checksum(oracle))
+    assert combine64(checksum(runner.state)) == combine64(checksum(oracle))
 
 
 def test_synctest_detects_nondeterminism():
@@ -148,4 +148,4 @@ def test_deep_prediction_window():
     assert runner.frame == 40
     assert runner.rollback_frames_total >= 30 * 9  # deep resims really ran
     # And the deeply-resimulated state equals straight-line simulation.
-    assert int(checksum(runner.state)) == int(checksum(oracle))
+    assert combine64(checksum(runner.state)) == combine64(checksum(oracle))
