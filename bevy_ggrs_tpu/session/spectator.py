@@ -63,10 +63,15 @@ class SpectatorSession:
         self._endpoint = PeerEndpoint(host_addr, rng)
         self.current_frame = 0
         self._events: List[SessionEvent] = []
-        # Consecutive polls whose input messages all started AHEAD of our
-        # confirmed frontier: the host has trimmed past us (stale-checkpoint
-        # resume) and the gap will never close.
-        self._gap_polls = 0
+        # Per-handle streak of consecutive POLLS whose input messages for
+        # that handle all started AHEAD of our confirmed frontier: the host
+        # has trimmed past us (stale-checkpoint resume) and that handle's
+        # gap will never close. Tracked per handle — one permanently gapped
+        # handle must surface even while the others keep progressing — and
+        # per poll, not per message, so resend rate doesn't skew the count.
+        self._gap_streak = [0] * self.num_players
+        self._poll_gap = [False] * self.num_players
+        self._poll_ok = [False] * self.num_players
 
     # ------------------------------------------------------------------
 
@@ -109,6 +114,19 @@ class SpectatorSession:
             for h, q in enumerate(self._queues):
                 if q.last_confirmed_frame >= 0:
                     self._endpoint.send_input_ack(h, q.last_confirmed_frame, now)
+        # Fold this poll's per-handle observations into the gap streaks: a
+        # handle whose only messages this poll started past our frontier
+        # extends its streak; any message overlapping the frontier (host
+        # still retains our next frame) resets it. Polls with no input
+        # traffic for a handle leave its streak unchanged (a silent host is
+        # loss/idle, not evidence of trimmed history).
+        for h in range(self.num_players):
+            if self._poll_ok[h]:
+                self._gap_streak[h] = 0
+            elif self._poll_gap[h]:
+                self._gap_streak[h] += 1
+            self._poll_ok[h] = False
+            self._poll_gap[h] = False
         self._endpoint.poll(now, self.current_frame, 0)
         self._events.extend(self._endpoint.events)
         self._endpoint.events.clear()
@@ -125,10 +143,13 @@ class SpectatorSession:
             # Span starts past our frontier. Transiently possible only if
             # reordering outran the redundant resend; persistently it means
             # the host trimmed history we never received (a checkpoint
-            # staler than the host's retained window) — count it so
+            # staler than the host's retained window) — flag it so
             # advance_frame can fail loudly instead of stalling forever.
-            self._gap_polls += 1
+            self._poll_gap[h] = True
             return
+        # Span reaches our frontier: the host still retains our next frame,
+        # so this handle's gap (if any) is bridgeable.
+        self._poll_ok[h] = True
         for frame, bits in proto.unpack_input_span(
             msg, np.dtype(self._zero.dtype), self._zero.shape
         ):
@@ -137,7 +158,6 @@ class SpectatorSession:
             if frame != queue.last_confirmed_frame + 1:
                 break  # gap: wait for the redundant resend
             queue.add_input(frame, bits)
-            self._gap_polls = 0
 
     # ------------------------------------------------------------------
     # Checkpoint / resume
@@ -179,7 +199,7 @@ class SpectatorSession:
             raise NotSynchronized("spectator has not synchronized with host")
         confirmed = self._confirmed_frame()
         if confirmed < self.current_frame:
-            if self._gap_polls > 120:
+            if max(self._gap_streak) > 120:
                 raise NotSynchronized(
                     "confirmed-input stream has an unbridgeable gap (the "
                     "host no longer retains frames past our frontier — "

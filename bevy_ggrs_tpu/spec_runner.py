@@ -53,7 +53,30 @@ from bevy_ggrs_tpu.parallel.speculate import (
 )
 from bevy_ggrs_tpu.runner import RollbackRunner, _Step
 from bevy_ggrs_tpu.schedule import Schedule
-from bevy_ggrs_tpu.state import SnapshotRing, WorldState, ring_load
+from bevy_ggrs_tpu.state import SnapshotRing, WorldState, combine64, ring_load
+
+
+def _forward_fill(
+    last: np.ndarray, known: np.ndarray, known_mask: np.ndarray
+) -> np.ndarray:
+    """The session's actual prediction for a rollout span: per player, start
+    from the anchor-1 input and forward-fill the latest confirmed value into
+    unknown frames (a confirmed change inside the span keeps predicting the
+    NEW value afterwards, exactly like the repeat-last queues). Resuming the
+    anchor-1 input after a pinned prefix would diverge from the session's
+    prediction and force two-change branches no tree enumerates.
+
+    ``last[P, ...]``, ``known[F, P, ...]``, ``known_mask[F, P]`` — payload
+    dims beyond ``[F, P]`` are handled (vector inputs).
+    """
+    extra = known.ndim - 2
+    mask = known_mask.reshape(known_mask.shape + (1,) * extra)
+    base = np.empty_like(known)
+    carry = np.array(last, copy=True)
+    for t in range(known.shape[0]):
+        carry = np.where(mask[t], known[t], carry)
+        base[t] = carry
+    return base
 
 
 @functools.partial(jax.jit, static_argnames=("max_steps",))
@@ -241,6 +264,14 @@ class SpeculativeRollbackRunner(RollbackRunner):
                     (1,) + known_mask.shape + (1,) * extra
                 )
                 bits = jnp.where(mask_b, jnp.asarray(known)[None], bits)
+                # Branch 0 must BE the session's own forward-fill prediction
+                # (the engine strictly contains the reference's repeat-last
+                # policy): after a confirmed mid-span change, unknown frames
+                # keep predicting the NEW value, not the anchor-1 input the
+                # sampler repeated. Forward-fill per player on the host
+                # (small arrays), write the row on device.
+                base = _forward_fill(np.asarray(last), known, known_mask)
+                bits = bits.at[0].set(jnp.asarray(base))
         else:
             bits = self._structured_bits(np.asarray(last), known, known_mask)
         # anchor == self.frame: the current live state IS the anchor state
@@ -281,17 +312,7 @@ class SpeculativeRollbackRunner(RollbackRunner):
         held). Earlier change frames enumerate first: the first incorrect
         frame is usually near the confirmed frontier."""
         F, P, B = self.spec_frames, self.num_players, self.num_branches
-        # Base = the session's actual prediction: per player, forward-fill
-        # the latest known value (a confirmed change inside the span keeps
-        # predicting the NEW value afterwards, exactly like the repeat-last
-        # queues) — resuming the anchor-1 input after a pinned prefix would
-        # make branch 0 diverge from the session's prediction and force
-        # two-change branches the tree never enumerates.
-        base = np.empty((F, P), dtype=last.dtype)
-        carry = last.copy()
-        for t in range(F):
-            carry = np.where(known_mask[t], known[t], carry)
-            base[t] = carry
+        base = _forward_fill(last, known, known_mask)
         out = np.broadcast_to(base, (B, F, P)).copy()
         b = 1
         frames_idx = np.arange(F)
@@ -372,9 +393,11 @@ class SpeculativeRollbackRunner(RollbackRunner):
                 if wants is None or wants(load_frame + t)
             ]
             if report:
-                cs_host = np.asarray(checksums)
+                cs_host = np.asarray(checksums)  # [T, 2] lo/hi lanes
                 for t in report:
-                    session.report_checksum(load_frame + t, int(cs_host[t]))
+                    session.report_checksum(
+                        load_frame + t, combine64(cs_host[t])
+                    )
         for t, s in enumerate(steps[:n_commit]):
             self._input_log[load_frame + t] = np.asarray(s.adv.bits)
         self.frame = load_frame + n_commit

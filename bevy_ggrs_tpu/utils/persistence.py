@@ -130,15 +130,18 @@ def restore_runner(path: str, runner, session=None) -> Dict:
     """Restore ``runner`` (and optionally ``session``) in place from
     :func:`save_runner` output; the runner must have been constructed with
     the same registry, capacity, and ``max_prediction`` (leaf validation
-    enforces this). Returns the saved metadata."""
+    enforces this). Returns the saved metadata.
+
+    All-or-nothing: everything that can raise (checkpoint validation, frame
+    parse, session restore) happens before the first runner field is
+    assigned, and a failing session restore rolls the session back to its
+    pre-call state — so a caller falling back to an older checkpoint
+    (``CheckpointManager.restore_latest``) never observes a runner at frame
+    N paired with a session at frame 0 (the save-frame invariant)."""
     tree, meta = load_checkpoint(
         path, {"state": runner.state, "ring": runner.ring}
     )
-    runner.state = tree["state"]
-    runner.ring = tree["ring"]
-    runner.frame = int(meta["frame"])
-    runner.rollbacks_total = int(meta.get("rollbacks_total", 0))
-    runner.rollback_frames_total = int(meta.get("rollback_frames_total", 0))
+    frame = int(meta["frame"])
     if session is not None:
         sd = meta.get("session_state")
         if sd is None:
@@ -146,7 +149,19 @@ def restore_runner(path: str, runner, session=None) -> Dict:
                 "checkpoint carries no session state; save with "
                 "save_runner(..., session=...) to resume a session"
             )
-        session.load_state_dict(sd)
+        backup = session.state_dict()
+        try:
+            session.load_state_dict(sd)
+        except BaseException:
+            session.load_state_dict(backup)
+            raise
+    # Plain attribute assignment from here on — cannot raise, so runner and
+    # session move to the checkpointed frame together.
+    runner.state = tree["state"]
+    runner.ring = tree["ring"]
+    runner.frame = frame
+    runner.rollbacks_total = int(meta.get("rollbacks_total", 0))
+    runner.rollback_frames_total = int(meta.get("rollback_frames_total", 0))
     return meta
 
 
