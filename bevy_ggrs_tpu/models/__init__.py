@@ -6,6 +6,8 @@ systems.
 - ``boids`` — entity-coupled O(N²) flocking (VPU / Pallas showcase)
 - ``neural_bots`` — MLP-policy agents (MXU showcase: batched inference
   inside the rollback domain, weights as rollback state)
+- ``projectiles`` — dynamic entity lifecycle (in-step spawn/despawn with a
+  device-resident rollback-id allocator)
 """
 
-from bevy_ggrs_tpu.models import boids, box_game, neural_bots
+from bevy_ggrs_tpu.models import boids, box_game, neural_bots, projectiles

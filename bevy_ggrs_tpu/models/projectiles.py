@@ -1,0 +1,339 @@
+"""Projectiles: dynamic entity lifecycle driven from inside game systems.
+
+The reference's restore path handles entities created or destroyed during
+mispredicted frames — find-or-spawn by rollback id plus despawn of live
+entities absent from the snapshot (``/root/reference/src/world_snapshot.rs:
+140-151,190-193``) — and users mint ids for mid-game spawns through
+``RollbackIdProvider`` (``/root/reference/src/lib.rs:59-75``). box_game and
+boids never exercise that: their entity sets are fixed at setup. This model
+makes spawn/despawn the gameplay itself, so rollback across entity-set
+changes is what SyncTest/P2P certify:
+
+- each player steers a TURRET (like a box_game cube, 2D);
+- the FIRE bit spawns a PROJECTILE entity *inside the jitted step* — a
+  vectorized claim of free capacity slots with a fresh rollback id from a
+  device-resident allocator;
+- projectiles fly straight, expire after ``PROJ_TTL`` frames, leave the
+  arena, or hit an opposing turret (scoring a point) — all three release
+  the slot (despawn) inside the step.
+
+TPU-native design notes:
+
+- Spawn is a masked scatter: firing players are ranked with a cumulative
+  sum, matched rank-for-rank to free slots (``searchsorted`` over the
+  free-slot prefix sum), and written with out-of-bounds-drop scatters when
+  capacity is exhausted — no data-dependent shapes, so the step stays one
+  fused XLA program under ``lax.scan``/``vmap``.
+- The rollback-id allocator is a REGISTERED RESOURCE (``next_rollback_id``):
+  rolling back rewinds the allocator with everything else, so a respawned
+  projectile gets the same id on resimulation — the id-stability contract of
+  ``Rollback { id }`` (``src/lib.rs:40-55``) without host round trips.
+  Device-minted ids start at ``DEVICE_ID_BASE`` so they never collide with
+  host-side ``RollbackIdProvider`` ids (which count up from 0).
+- All math is float32 add/mul/compare with a fixed operation order —
+  bit-reproducible per platform, so speculative (vmapped) and serial
+  executions agree bitwise (attested in tests).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from bevy_ggrs_tpu.schedule import InputSpec, PlayerInputs, Schedule
+from bevy_ggrs_tpu.state import HostWorld, TypeRegistry, WorldState
+
+INPUT_UP = 1 << 0
+INPUT_DOWN = 1 << 1
+INPUT_LEFT = 1 << 2
+INPUT_RIGHT = 1 << 3
+INPUT_FIRE = 1 << 4
+
+INPUT_SPEC = InputSpec(shape=(), dtype=jnp.uint8)
+
+KIND_TURRET = 0
+KIND_PROJECTILE = 1
+
+TURRET_SPEED = np.float32(0.06)
+PROJ_SPEED = np.float32(0.25)
+PROJ_TTL = 48  # frames a projectile lives
+FIRE_COOLDOWN = 6  # frames between shots per player
+HIT_RADIUS = np.float32(0.35)
+ARENA_HALF = np.float32(4.0)
+
+MAX_PLAYERS = 8
+# Device-minted rollback ids live above every host-minted id.
+DEVICE_ID_BASE = 1 << 20
+
+
+def make_registry() -> TypeRegistry:
+    reg = TypeRegistry()
+    reg.register_component("position", shape=(2,), dtype=jnp.float32)
+    reg.register_component("velocity", shape=(2,), dtype=jnp.float32)
+    # Facing direction a fired projectile inherits; updated by movement.
+    reg.register_component("aim", shape=(2,), dtype=jnp.float32)
+    reg.register_component("kind", shape=(), dtype=jnp.int32, default=KIND_TURRET)
+    reg.register_component("owner", shape=(), dtype=jnp.int32, default=-1)
+    reg.register_component("ttl", shape=(), dtype=jnp.int32, default=0)
+    reg.register_resource("frame_count", jnp.uint32(0))
+    # The in-step rollback-id allocator (see module docstring).
+    reg.register_resource("next_rollback_id", jnp.int32(DEVICE_ID_BASE))
+    reg.register_resource("fire_cooldown", np.zeros((MAX_PLAYERS,), np.int32))
+    reg.register_resource("score", np.zeros((MAX_PLAYERS,), np.int32))
+    return reg
+
+
+def make_world(
+    num_players: int, capacity: int = 64, registry: Optional[TypeRegistry] = None
+) -> HostWorld:
+    """Turrets on a circle; all remaining capacity is projectile headroom."""
+    if not 1 <= num_players <= MAX_PLAYERS:
+        raise ValueError(f"num_players must be 1..{MAX_PLAYERS}")
+    world = HostWorld(registry or make_registry(), capacity)
+    r = float(ARENA_HALF) * 0.5
+    for handle in range(num_players):
+        ang = 2.0 * np.pi * handle / num_players
+        world.spawn(
+            {
+                "position": np.array(
+                    [r * np.cos(ang), r * np.sin(ang)], dtype=np.float32
+                ),
+                "velocity": np.zeros(2, np.float32),
+                "aim": np.array([1.0, 0.0], np.float32),
+                "kind": KIND_TURRET,
+                "owner": handle,
+                "ttl": 0,
+            },
+            rollback_id=handle,
+        )
+    return world
+
+
+# ---------------------------------------------------------------------------
+# Systems
+# ---------------------------------------------------------------------------
+
+
+def _input_dirs(inputs: PlayerInputs) -> jnp.ndarray:
+    """[P, 2] move/aim direction per player from the bitmask."""
+    bits = inputs.bits.astype(jnp.uint32)
+    dx = (
+        ((bits & INPUT_RIGHT) != 0).astype(jnp.float32)
+        - ((bits & INPUT_LEFT) != 0).astype(jnp.float32)
+    )
+    dy = (
+        ((bits & INPUT_UP) != 0).astype(jnp.float32)
+        - ((bits & INPUT_DOWN) != 0).astype(jnp.float32)
+    )
+    return jnp.stack([dx, dy], axis=1)
+
+
+def move_turret_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
+    """Turrets translate by their player's direction keys and re-aim when a
+    direction is held (box_game movement flattened to 2D, ``box_game.rs:
+    154-203``)."""
+    pos = state.components["position"]
+    aim = state.components["aim"]
+    kind = state.components["kind"]
+    owner = state.components["owner"]
+
+    dirs = _input_dirs(inputs)  # [P, 2]
+    safe = jnp.clip(owner, 0, inputs.num_players - 1)
+    d = dirs[safe]  # [cap, 2]
+
+    is_turret = (
+        state.alive
+        & state.present["position"]
+        & (kind == KIND_TURRET)
+        & (owner >= 0)
+    )
+    sel = is_turret[:, None]
+    new_pos = jnp.clip(pos + d * TURRET_SPEED, -ARENA_HALF, ARENA_HALF)
+    moved = jnp.any(d != 0.0, axis=1, keepdims=True)
+    new_aim = jnp.where(moved, d, aim)
+    return state.replace(
+        components={
+            **state.components,
+            "position": jnp.where(sel, new_pos, pos),
+            "aim": jnp.where(sel, new_aim, aim),
+        }
+    )
+
+
+def fire_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
+    """Spawn one projectile per firing player — entity creation INSIDE the
+    jitted step (the capability ``world_snapshot.rs:140-151`` restores
+    across rollbacks).
+
+    Claim rule (deterministic, shape-static): firing players ranked by
+    handle take free slots in ascending slot order; when fewer free slots
+    than firers remain, the highest-ranked firers' shots fizzle (scatters
+    drop out-of-bounds writes).
+    """
+    cap = state.capacity
+    num_players = inputs.num_players
+    bits = inputs.bits.astype(jnp.uint32)
+    cooldown = state.resources["fire_cooldown"]
+
+    # Which players fire this frame: FIRE held, cooldown elapsed, and their
+    # turret alive (dead turrets can't shoot; turrets are immortal here but
+    # the mask keeps the rule total).
+    kind = state.components["kind"]
+    owner = state.components["owner"]
+    is_turret = state.alive & (kind == KIND_TURRET) & (owner >= 0)
+    # Per-player turret slot: argmax of the one-hot (owner==p & turret).
+    p_range = jnp.arange(num_players)
+    turret_one_hot = is_turret[None, :] & (owner[None, :] == p_range[:, None])
+    turret_slot = jnp.argmax(turret_one_hot, axis=1)  # [P]
+    has_turret = jnp.any(turret_one_hot, axis=1)
+
+    firing = (
+        ((bits & INPUT_FIRE) != 0)
+        & (cooldown[:num_players] <= 0)
+        & has_turret
+    )  # [P]
+
+    # Rank firers (0-based among firing players, by handle order) and match
+    # them to free slots in ascending slot order.
+    rank = jnp.cumsum(firing.astype(jnp.int32)) - 1  # [P], valid where firing
+    free = ~state.alive
+    free_prefix = jnp.cumsum(free.astype(jnp.int32))  # [cap]
+    n_free = free_prefix[-1]
+    # slot of the k-th (0-based) free slot = first index with prefix == k+1.
+    slots = jnp.searchsorted(free_prefix, rank + 1, side="left")  # [P]
+    can = firing & (rank < n_free)
+    # Out-of-range target -> scatter drops the write entirely.
+    target = jnp.where(can, slots, cap)  # [P]
+
+    next_id = state.resources["next_rollback_id"]
+    tpos = state.components["position"][turret_slot]  # [P, 2]
+    taim = state.components["aim"][turret_slot]  # [P, 2]
+    # Normalize aim so diagonal shots aren't faster (fixed op order).
+    norm = jnp.sqrt(jnp.sum(taim * taim, axis=1, keepdims=True))
+    aim_unit = taim / jnp.maximum(norm, jnp.float32(1e-6))
+
+    alive = state.alive.at[target].set(True, mode="drop")
+    rollback_id = state.rollback_id.at[target].set(
+        next_id + rank, mode="drop"
+    )
+    comps = dict(state.components)
+    pres = dict(state.present)
+    comps["position"] = comps["position"].at[target].set(tpos, mode="drop")
+    comps["velocity"] = comps["velocity"].at[target].set(
+        aim_unit * PROJ_SPEED, mode="drop"
+    )
+    comps["aim"] = comps["aim"].at[target].set(aim_unit, mode="drop")
+    comps["kind"] = comps["kind"].at[target].set(KIND_PROJECTILE, mode="drop")
+    comps["owner"] = comps["owner"].at[target].set(p_range, mode="drop")
+    comps["ttl"] = comps["ttl"].at[target].set(PROJ_TTL, mode="drop")
+    # Mark present ONLY the components written above: a user registry may
+    # carry extra components, and flagging them present would expose the
+    # slot's previous occupant's stale values to systems and the checksum.
+    for name in ("position", "velocity", "aim", "kind", "owner", "ttl"):
+        pres[name] = pres[name].at[target].set(True, mode="drop")
+
+    spawned = jnp.sum(can.astype(jnp.int32))
+    # Every firing player restarts their cooldown — a fizzled (capacity-
+    # dropped) shot still counts as having pulled the trigger.
+    cd_now = jnp.where(
+        firing, jnp.int32(FIRE_COOLDOWN), cooldown[:num_players]
+    )
+    cooldown = cooldown.at[:num_players].set(cd_now)
+
+    return state.replace(
+        alive=alive,
+        rollback_id=rollback_id,
+        components=comps,
+        present=pres,
+        resources={
+            **state.resources,
+            "next_rollback_id": next_id + spawned,
+            "fire_cooldown": cooldown,
+        },
+    )
+
+
+def projectile_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
+    """Fly, age, collide, expire — entity DESTRUCTION inside the jitted step
+    (the despawn side of ``world_snapshot.rs:190-193``).
+
+    A projectile despawns when its ttl runs out, it leaves the arena, or it
+    passes within ``HIT_RADIUS`` of an opposing turret (which scores its
+    owner a point).
+    """
+    del inputs
+    pos = state.components["position"]
+    vel = state.components["velocity"]
+    kind = state.components["kind"]
+    owner = state.components["owner"]
+    ttl = state.components["ttl"]
+
+    is_proj = state.alive & (kind == KIND_PROJECTILE)
+    is_turret = state.alive & (kind == KIND_TURRET) & (owner >= 0)
+
+    new_pos = jnp.where(is_proj[:, None], pos + vel, pos)
+    new_ttl = jnp.where(is_proj, ttl - 1, ttl)
+
+    # Pairwise projectile-vs-turret hits on the moved positions.
+    diff = new_pos[:, None, :] - new_pos[None, :, :]  # [cap, cap, 2]
+    d2 = jnp.sum(diff * diff, axis=2)
+    hit = (
+        is_proj[:, None]
+        & is_turret[None, :]
+        & (owner[:, None] != owner[None, :])
+        & (d2 < HIT_RADIUS * HIT_RADIUS)
+    )  # [cap, cap]
+    proj_hit = jnp.any(hit, axis=1)
+
+    # Score: one point per hit projectile to its owner (a projectile grazing
+    # two turrets in the same frame still scores once).
+    score = state.resources["score"]
+    safe_owner = jnp.clip(owner, 0, MAX_PLAYERS - 1)
+    score = score.at[safe_owner].add(proj_hit.astype(jnp.int32))
+
+    out = jnp.any(jnp.abs(new_pos) > ARENA_HALF, axis=1)
+    gone = is_proj & ((new_ttl <= 0) | out | proj_hit)
+
+    alive = state.alive & ~gone
+    rollback_id = jnp.where(gone, -1, state.rollback_id)
+    pres = {n: p & ~gone for n, p in state.present.items()}
+    return state.replace(
+        alive=alive,
+        rollback_id=rollback_id,
+        components={**state.components, "position": new_pos, "ttl": new_ttl},
+        present=pres,
+        resources={**state.resources, "score": score},
+    )
+
+
+def cooldown_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
+    del inputs
+    cd = state.resources["fire_cooldown"]
+    return state.replace(
+        resources={
+            **state.resources,
+            "fire_cooldown": jnp.maximum(cd - 1, 0),
+        }
+    )
+
+
+def increase_frame_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
+    del inputs
+    return state.replace(
+        resources={
+            **state.resources,
+            "frame_count": state.resources["frame_count"] + jnp.uint32(1),
+        }
+    )
+
+
+def make_schedule() -> Schedule:
+    return Schedule([
+        move_turret_system,
+        fire_system,
+        projectile_system,
+        cooldown_system,
+        increase_frame_system,
+    ])

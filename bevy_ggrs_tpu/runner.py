@@ -214,6 +214,74 @@ class RollbackRunner:
         boundary — the only place non-rollback code should read from)."""
         return to_host(self.state)
 
+    # ------------------------------------------------------------------
+    # Live-session entity lifecycle (host side)
+
+    def spawn(self, components: dict, rollback_id: int) -> int:
+        """Spawn an entity into the LIVE state mid-session; returns its slot.
+
+        The host-side analog of a user system spawning via
+        ``RollbackIdProvider`` (``/root/reference/src/lib.rs:59-75``): call
+        between ticks with an id from the app's provider. Reference-parity
+        rollback semantics apply (``world_snapshot.rs:190-193``): the entity
+        exists in snapshots saved from now on; a rollback to a frame saved
+        BEFORE this call restores a world without it, and — being created by
+        the host rather than by a system — resimulation does NOT recreate
+        it. Spawn during a tick boundary (right after ``handle_requests``)
+        and treat a deeper-than-spawn rollback as the entity never having
+        existed. For entities that must survive arbitrary rollbacks, spawn
+        from inside a system (see ``models/projectiles.py``).
+        """
+        import jax.numpy as jnp
+
+        alive = np.asarray(self.state.alive)
+        rids = np.asarray(self.state.rollback_id)
+        if int(rollback_id) in rids[alive]:
+            raise ValueError(f"duplicate rollback_id {rollback_id}")
+        free = np.flatnonzero(~alive)
+        if free.size == 0:
+            raise RuntimeError(f"world capacity {alive.shape[0]} exhausted")
+        slot = int(free[0])
+        comps = dict(self.state.components)
+        pres = dict(self.state.present)
+        for name, value in components.items():
+            if name not in comps:
+                raise KeyError(f"component {name!r} not registered")
+            comps[name] = comps[name].at[slot].set(
+                jnp.asarray(value, comps[name].dtype)
+            )
+            pres[name] = pres[name].at[slot].set(True)
+        self.state = self.state.replace(
+            alive=self.state.alive.at[slot].set(True),
+            rollback_id=self.state.rollback_id.at[slot].set(
+                np.int32(rollback_id)
+            ),
+            components=comps,
+            present=pres,
+        )
+        return slot
+
+    def despawn(self, rollback_id: int) -> bool:
+        """Despawn the live entity carrying ``rollback_id``; returns whether
+        it existed. Same rollback semantics as :meth:`spawn`: snapshots
+        saved before this call still contain the entity, so a rollback
+        across the despawn resurrects it for the replayed frames."""
+        alive = np.asarray(self.state.alive)
+        rids = np.asarray(self.state.rollback_id)
+        hits = np.flatnonzero(alive & (rids == int(rollback_id)))
+        if hits.size == 0:
+            return False
+        slot = int(hits[0])
+        self.state = self.state.replace(
+            alive=self.state.alive.at[slot].set(False),
+            rollback_id=self.state.rollback_id.at[slot].set(-1),
+            present={
+                n: p.at[slot].set(False)
+                for n, p in self.state.present.items()
+            },
+        )
+        return True
+
     def diagnose_frame(self, frame: int):
         """Per-component checksum breakdown of the snapshot saved for
         ``frame`` (None if its ring slot was overwritten). On a

@@ -26,7 +26,8 @@ import numpy as np  # noqa: E402
 
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--model", choices=["boids", "neural_bots"],
+    parser.add_argument("--model",
+                        choices=["boids", "neural_bots", "projectiles"],
                         default="boids")
     parser.add_argument("--entities", type=int, default=256)
     parser.add_argument("--num-players", type=int, default=2)
@@ -37,7 +38,7 @@ def main() -> int:
     args = parser.parse_args()
     force_platform(args.platform)
 
-    from bevy_ggrs_tpu.models import boids, neural_bots
+    from bevy_ggrs_tpu.models import boids, neural_bots, projectiles
     from bevy_ggrs_tpu.runner import RollbackRunner
     from bevy_ggrs_tpu.session import MismatchedChecksum, SyncTestSession
     from bevy_ggrs_tpu.state import combine64, checksum
@@ -46,6 +47,12 @@ def main() -> int:
         model = boids
         schedule = boids.make_schedule(use_pallas=args.pallas)
         world = boids.make_world(args.entities, args.num_players)
+    elif args.model == "projectiles":
+        model = projectiles
+        schedule = projectiles.make_schedule()
+        world = projectiles.make_world(
+            args.num_players, capacity=args.entities
+        )
     else:
         model = neural_bots
         schedule = neural_bots.make_schedule()
@@ -65,11 +72,14 @@ def main() -> int:
         runner.metrics = inst.metrics
 
     rng = np.random.RandomState(0)
+    # projectiles adds a FIRE bit (1<<4) — include it so the harness
+    # exercises spawn/despawn under the forced rollbacks.
+    hi = 32 if args.model == "projectiles" else 16
     try:
         with inst:
             for i in range(args.frames):
                 for h in range(args.num_players):
-                    session.add_local_input(h, np.uint8(rng.randint(0, 16)))
+                    session.add_local_input(h, np.uint8(rng.randint(0, hi)))
                 runner.handle_requests(session.advance_frame(), session)
     except MismatchedChecksum as exc:
         print(f"DESYNC: {exc}", file=sys.stderr)
