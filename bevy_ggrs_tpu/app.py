@@ -22,6 +22,7 @@ TPU-native analog of the reference's L4/L2 surface
 
 from __future__ import annotations
 
+import dataclasses
 import enum
 import time as _time
 from typing import Callable, List, Optional
@@ -53,14 +54,24 @@ class SessionType(enum.Enum):
 
 
 class RollbackIdProvider:
-    """Monotonic rollback-id allocator (`src/lib.rs:59-75`)."""
+    """Monotonic rollback-id allocator (`src/lib.rs:59-75`).
+
+    Host-minted ids own ``0 .. DEVICE_ID_BASE-1``; everything above is
+    reserved for device-resident allocators (``models/projectiles.py``), so
+    exhaustion here trips at the boundary rather than at ``u32::MAX`` like
+    the reference (`lib.rs:67-69`)."""
 
     def __init__(self) -> None:
         self._next = 0
 
     def next_id(self) -> int:
-        if self._next >= 2**32 - 1:
-            raise OverflowError("RollbackIdProvider: no more unique ids")
+        from bevy_ggrs_tpu.state import DEVICE_ID_BASE
+
+        if self._next >= DEVICE_ID_BASE:
+            raise OverflowError(
+                "RollbackIdProvider: host id space exhausted "
+                f"(0..{DEVICE_ID_BASE - 1}; above is device-minted)"
+            )
         out = self._next
         self._next += 1
         return out
@@ -149,6 +160,7 @@ class GGRSStage:
         clock=None,
         metrics=None,
         speculation: Optional[int] = None,
+        speculation_opts: Optional[dict] = None,
     ):
         from bevy_ggrs_tpu.utils.metrics import null_metrics
 
@@ -166,6 +178,7 @@ class GGRSStage:
                 input_spec=input_spec,
                 num_branches=speculation,
                 metrics=self.metrics,
+                **(speculation_opts or {}),
             )
         else:
             self.runner = RollbackRunner(
@@ -285,6 +298,7 @@ class GGRSPlugin:
         self.clock = None
         self.metrics = None
         self.speculation: Optional[int] = None
+        self.speculation_opts: Optional[dict] = None
 
     def with_update_frequency(self, fps: int) -> "GGRSPlugin":
         self.update_frequency = int(fps)
@@ -342,12 +356,26 @@ class GGRSPlugin:
         self.metrics = metrics
         return self
 
-    def with_speculation(self, num_branches: int) -> "GGRSPlugin":
+    def with_speculation(
+        self, num_branches: int, branch_values=None, attest: bool = True
+    ) -> "GGRSPlugin":
         """Precompute rollback recoveries with a ``num_branches``-wide
         speculative rollout each frame (P2P only; see
-        :mod:`bevy_ggrs_tpu.spec_runner`). Values <= 0 disable."""
+        :mod:`bevy_ggrs_tpu.spec_runner`). Values <= 0 disable.
+
+        ``branch_values`` overrides the candidate input values the
+        structured branch tree enumerates; by default they come from the
+        model's ``InputSpec.values`` declaration (so e.g. projectiles' FIRE
+        bit is enumerable without extra wiring). With ``attest`` (default),
+        warmup machine-checks that the vmapped rollout and the serial burst
+        agree bitwise for this model and auto-disables speculation — with a
+        ``SPECULATION_DISABLED`` event in ``app.events`` — when they don't.
+        """
         n = int(num_branches)
         self.speculation = n if n > 0 else None
+        self.speculation_opts = {"attest": bool(attest)}
+        if branch_values is not None:
+            self.speculation_opts["branch_values"] = list(branch_values)
         return self
 
     def build(self, app: Optional[RollbackApp] = None) -> RollbackApp:
@@ -369,5 +397,16 @@ class GGRSPlugin:
             clock=self.clock,
             metrics=self.metrics,
             speculation=self.speculation,
+            speculation_opts=self.speculation_opts,
         )
+        attestation = getattr(app.stage.runner, "attestation", None)
+        if attestation is not None and not attestation.ok:
+            from bevy_ggrs_tpu.session.common import EventKind, SessionEvent
+
+            app.events.append(
+                SessionEvent(
+                    EventKind.SPECULATION_DISABLED,
+                    data=dataclasses.asdict(attestation),
+                )
+            )
         return app

@@ -38,7 +38,8 @@ INPUT_DOWN = 1 << 1
 INPUT_LEFT = 1 << 2
 INPUT_RIGHT = 1 << 3
 
-INPUT_SPEC = InputSpec(shape=(), dtype=jnp.uint8)
+# 4 steering bits -> value universe 0..15 for speculation branch trees.
+INPUT_SPEC = InputSpec(shape=(), dtype=jnp.uint8, values=tuple(range(16)))
 
 # Flocking parameters (2D plane).
 NEIGHBOR_RADIUS = 1.0

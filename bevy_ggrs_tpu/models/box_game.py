@@ -47,7 +47,8 @@ FRICTION = 0.9
 PLANE_SIZE = 5.0
 CUBE_SIZE = 0.2
 
-INPUT_SPEC = InputSpec(shape=(), dtype=jnp.uint8)
+# 4 movement bits -> value universe 0..15 for speculation branch trees.
+INPUT_SPEC = InputSpec(shape=(), dtype=jnp.uint8, values=tuple(range(16)))
 
 
 def make_registry() -> TypeRegistry:

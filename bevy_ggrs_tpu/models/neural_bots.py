@@ -21,6 +21,11 @@ Design points:
   applies unchanged with the same u8 bitmask inputs as box_game.
 - Determinism: matmuls in float32 with fixed shapes — bit-reproducible per
   platform+executable like every other model here (docs/determinism.md).
+  NOT attested speculation-safe everywhere: vmapping the policy over
+  speculative branches makes the matmuls batched, and backends may
+  accumulate batched matmuls in a different order (the CPU backend does —
+  caught by ``spec_runner.attest_speculation_safety``, which auto-disables
+  speculation for this model there; the serial rollback path is unaffected).
 
 Observation (8 features): bot velocity (2), vector to own target (2),
 distance to target (1), vector to flock centroid (2), bias (1).
@@ -44,7 +49,8 @@ INPUT_DOWN = 1 << 1
 INPUT_LEFT = 1 << 2
 INPUT_RIGHT = 1 << 3
 
-INPUT_SPEC = InputSpec(shape=(), dtype=jnp.uint8)
+# 4 command bits -> value universe 0..15 for speculation branch trees.
+INPUT_SPEC = InputSpec(shape=(), dtype=jnp.uint8, values=tuple(range(16)))
 
 OBS_DIM = 8
 HIDDEN = 32

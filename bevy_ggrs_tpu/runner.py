@@ -234,6 +234,18 @@ class RollbackRunner:
         """
         import jax.numpy as jnp
 
+        from bevy_ggrs_tpu.state import DEVICE_ID_BASE
+
+        if not 0 <= int(rollback_id) < DEVICE_ID_BASE:
+            # Host ids own 0..DEVICE_ID_BASE-1; ids above belong to
+            # device-resident allocators (models/projectiles.py) — a
+            # host-minted id up there could later collide with a
+            # device-minted one, silently merging two entities' histories.
+            raise ValueError(
+                f"rollback_id {rollback_id} outside the host id space "
+                f"0..{DEVICE_ID_BASE - 1} (>= DEVICE_ID_BASE is reserved "
+                "for device-minted ids)"
+            )
         alive = np.asarray(self.state.alive)
         rids = np.asarray(self.state.rollback_id)
         if int(rollback_id) in rids[alive]:

@@ -24,7 +24,7 @@ predicted / disconnected — ggrs ``InputStatus`` consumed at
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Sequence, Tuple
+from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -45,10 +45,17 @@ class InputSpec:
     The reference requires ``Config::Input: Pod`` (a flat byte struct,
     ``examples/box_game/box_game.rs:34-38``); here the input is a fixed-shape
     integer array. Default matches box_game's single ``u8`` bitmask.
+
+    ``values`` optionally declares the model's input-value universe (e.g.
+    ``range(16)`` for a 4-bit bitmask, ``range(32)`` when a FIRE bit
+    exists). Speculation's structured branch trees enumerate candidate
+    futures from this set — a model whose spec omits it falls back to the
+    4-bit default and can never speculatively hit a change in higher bits.
     """
 
     shape: Tuple[int, ...] = ()
     dtype: Any = jnp.uint8
+    values: Optional[Tuple[int, ...]] = None
 
     def zeros(self, num_players: int) -> jnp.ndarray:
         return jnp.zeros((num_players,) + self.shape, dtype=self.dtype)

@@ -68,6 +68,14 @@ class EventKind(enum.Enum):
     NETWORK_RESUMED = "network_resumed"
     WAIT_RECOMMENDATION = "wait_recommendation"  # skip frames to let peers catch up
     DESYNC_DETECTED = "desync_detected"
+    # Extension over ggrs's enum: a peer keeps sending datagrams with our
+    # magic but a different protocol version — without this, mixed-version
+    # peers hang in SYNCHRONIZING forever with no operator-visible signal.
+    VERSION_MISMATCH = "version_mismatch"  # data: (peer_version, count)
+    # Extension: speculation-safety attestation failed at warmup — the
+    # vmapped rollout and serial burst disagreed bitwise for this model, so
+    # speculative recovery was auto-disabled (serial path stays correct).
+    SPECULATION_DISABLED = "speculation_disabled"  # data: attestation detail
 
 
 @dataclasses.dataclass(frozen=True)

@@ -34,6 +34,10 @@ KEEP_ALIVE_INTERVAL = 0.2
 CHECKSUM_REPORT_INTERVAL_FRAMES = 16
 DEFAULT_DISCONNECT_TIMEOUT = 2.0
 DEFAULT_DISCONNECT_NOTIFY_START = 0.5
+# Mismatched-version datagrams from one peer before VERSION_MISMATCH fires
+# (small enough to trigger well inside the sync retry window, large enough
+# that one stray/spoofed datagram doesn't raise a false alarm).
+VERSION_MISMATCH_THRESHOLD = 5
 # Max frames per InputMsg: keeps the wire span well under the uint16 field
 # and one MTU even for late-joining spectators catching up on long history.
 MAX_INPUT_SPAN = 120
@@ -89,6 +93,10 @@ class PeerEndpoint:
 
         # Remote checksum reports for desync detection: frame -> checksum.
         self.remote_checksums: Dict[int, int] = {}
+
+        # Version-skew accounting (the datagrams themselves are dropped).
+        self.version_mismatches = 0
+        self._version_mismatch_reported = False
 
     # ------------------------------------------------------------------
 
@@ -198,6 +206,34 @@ class PeerEndpoint:
                 for f in sorted(self.remote_checksums)[:-64]:
                     del self.remote_checksums[f]
         # KeepAlive: nothing beyond the last_recv bump.
+
+    def note_undecodable(self, data: bytes) -> None:
+        """Called with a datagram ``decode`` rejected: if it was OUR magic at
+        a different version (vs plain garbage), count it toward the skew
+        alarm."""
+        skew = proto.version_mismatch(data)
+        if skew is not None:
+            self.note_version_mismatch(skew)
+
+    def note_version_mismatch(self, peer_version: int) -> None:
+        """Count a dropped mixed-version datagram from this peer; after
+        VERSION_MISMATCH_THRESHOLD of them, emit one VERSION_MISMATCH event
+        so a version-skewed peer surfaces instead of stalling sync forever
+        (the datagrams stay dropped — there is no cross-version parse)."""
+        self.version_mismatches += 1
+        if (
+            not self._version_mismatch_reported
+            and self.version_mismatches >= VERSION_MISMATCH_THRESHOLD
+        ):
+            self._version_mismatch_reported = True
+            self._emit(
+                EventKind.VERSION_MISMATCH,
+                data={
+                    "peer_version": peer_version,
+                    "local_version": proto.VERSION,
+                    "count": self.version_mismatches,
+                },
+            )
 
     def _ack(self, handle: int, ack_frame: int) -> None:
         pending = self._pending_output.get(handle)

@@ -192,6 +192,7 @@ class P2PSession:
                 continue  # unknown peer: drop (untrusted input)
             msg = proto.decode(data)
             if msg is None:
+                ep.note_undecodable(data)
                 continue
             ep.on_message(
                 msg,

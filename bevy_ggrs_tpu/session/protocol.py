@@ -22,7 +22,9 @@ import numpy as np
 MAGIC = 0x47  # 'G'
 # v2: ChecksumReport widened to 64 bits (the reference's saved-state cell is
 # u128-capable — ggrs_stage.rs:283; 32 bits collides too easily at one
-# compare per 16 confirmed frames). Version mismatch = datagram dropped.
+# compare per 16 confirmed frames). Version mismatch = datagram dropped, but
+# counted (see version_mismatch) so a skewed peer surfaces as an event
+# instead of an indefinite sync stall.
 VERSION = 2
 
 T_SYNC_REQUEST = 1
@@ -150,6 +152,19 @@ def encode(msg: Message) -> bytes:
             msg.frame, msg.checksum & 0xFFFFFFFFFFFFFFFF
         )
     raise TypeError(f"unknown message {msg!r}")
+
+
+def version_mismatch(data: bytes) -> Optional[int]:
+    """The sender's protocol version when this datagram carries our MAGIC but
+    a different VERSION; None otherwise. :func:`decode` drops such datagrams
+    (a v1 peer must not be half-parsed), but silently dropping them forever
+    leaves mixed-version peers stuck in SYNCHRONIZING — callers count these
+    and surface a VERSION_MISMATCH event so operators see the skew."""
+    if len(data) >= _HDR.size:
+        magic, version, _ = _HDR.unpack_from(data)
+        if magic == MAGIC and version != VERSION:
+            return version
+    return None
 
 
 def decode(data: bytes) -> Optional[Message]:

@@ -104,6 +104,7 @@ class SpectatorSession:
                 continue
             msg = proto.decode(data)
             if msg is None:
+                self._endpoint.note_undecodable(data)
                 continue
             if isinstance(msg, proto.InputMsg):
                 got_inputs = True

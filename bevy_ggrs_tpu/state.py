@@ -40,6 +40,12 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+# The rollback-id space is split so host-minted and device-minted ids can
+# never collide: host allocators (``RollbackIdProvider``, ``spawn``) own
+# ``0 .. DEVICE_ID_BASE-1``; device-resident allocators (in-step spawns, see
+# ``models/projectiles.py``) mint upward from ``DEVICE_ID_BASE``.
+DEVICE_ID_BASE = 1 << 20
+
 # ---------------------------------------------------------------------------
 # Type registry
 # ---------------------------------------------------------------------------

@@ -32,7 +32,11 @@ import jax.numpy as jnp
 import numpy as np
 
 _HEADER_KEY = "__ggrs_header__"
-_FORMAT_VERSION = 1
+# v2: SnapshotRing.checksums widened from uint32[depth] to uint32[depth, 2]
+# (two independent 64-bit lanes). A v1 checkpoint's ring no longer matches
+# any current template, so v1 fails the version gate with an explicit
+# message instead of a generic per-leaf shape mismatch.
+_FORMAT_VERSION = 2
 
 
 def _flatten(tree) -> Tuple[List[str], List[Any], Any]:
@@ -75,7 +79,13 @@ def load_checkpoint(path: str, template) -> Tuple[Any, Dict]:
     key path, shape, and dtype before any device transfer."""
     with np.load(path) as data:
         header = json.loads(bytes(data[_HEADER_KEY]).decode())
-        if header.get("version") != _FORMAT_VERSION:
+        # v1 is not rejected outright: the checksum widening shipped before
+        # the version bump, so v1 checkpoints exist in BOTH layouts. A v1
+        # file whose leaves validate is current-layout and loads normally;
+        # one whose ring checksums mismatch gets the explicit legacy error
+        # below instead of a generic shape message.
+        legacy_v1 = header.get("version") == 1
+        if not legacy_v1 and header.get("version") != _FORMAT_VERSION:
             raise ValueError(
                 f"checkpoint {path!r}: format version "
                 f"{header.get('version')} != {_FORMAT_VERSION}"
@@ -93,6 +103,17 @@ def load_checkpoint(path: str, template) -> Tuple[Any, Dict]:
             arr = data[f"leaf_{i}"]
             t_arr = np.asarray(t_leaf)
             if arr.shape != t_arr.shape or arr.dtype != t_arr.dtype:
+                if (
+                    legacy_v1
+                    and "checksums" in p
+                    and arr.ndim + 1 == t_arr.ndim
+                ):
+                    raise ValueError(
+                        f"checkpoint {path!r} predates 64-bit checksums "
+                        f"(leaf {p} is {list(arr.shape)}, now "
+                        f"uint32[depth, 2]) — re-save from a current "
+                        "session; pre-widening checkpoints cannot resume"
+                    )
                 raise ValueError(
                     f"checkpoint leaf {p}: {arr.dtype}{list(arr.shape)} != "
                     f"template {t_arr.dtype}{list(t_arr.shape)}"

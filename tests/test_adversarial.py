@@ -137,3 +137,30 @@ def test_lying_ack_ahead_cannot_stall_the_victim():
     assert ra.frame > 40 and rb.frame > 40, "ack-ahead lie stalled the pair"
     frames, pairs = common_confirmed_checksums(peers)
     assert frames and all(a == b for a, b in pairs)
+
+
+def test_version_skew_surfaces_instead_of_silent_stall():
+    """A peer speaking a different protocol version is dropped datagram by
+    datagram (no cross-version parse exists), but after a handful of them
+    the session emits VERSION_MISMATCH so operators see the skew instead of
+    an indefinite SYNCHRONIZING stall."""
+    net = LoopbackNetwork(latency=1 * FPS_DT, seed=11)
+    peers = make_pair(net)
+    # Re-version a legitimate message: same magic, version+1.
+    skewed = bytearray(proto.encode(proto.SyncRequest(nonce=1234)))
+    assert skewed[1] == proto.VERSION
+    skewed[1] = proto.VERSION + 1
+    events = []
+    for i in range(30):
+        net.advance(FPS_DT)
+        net._send(("peer", 1), ("peer", 0), bytes(skewed))
+        for session, runner in peers:
+            session.poll_remote_clients()
+            events.extend(session.events())
+    mismatches = [e for e in events if e.kind == EventKind.VERSION_MISMATCH]
+    assert len(mismatches) == 1, "one event per skewed peer, not per datagram"
+    assert mismatches[0].data["peer_version"] == proto.VERSION + 1
+    assert mismatches[0].data["local_version"] == proto.VERSION
+    assert mismatches[0].data["count"] >= 5
+    # A plain-garbage datagram (wrong magic) must NOT count as skew.
+    assert proto.version_mismatch(b"\x00" * 16) is None

@@ -165,3 +165,54 @@ def test_manager_restore_empty_dir(tmp_path):
     mgr = CheckpointManager(str(tmp_path / "none"), interval=5)
     _, runner = _make_pair()
     assert mgr.restore_latest(runner) is None
+
+
+def _rewrite_as_v1(path):
+    """Stamp an on-disk checkpoint's header back to format version 1."""
+    import json
+
+    from bevy_ggrs_tpu.utils import persistence as P
+
+    with np.load(path) as data:
+        arrays = {k: data[k] for k in data.files}
+    header = json.loads(bytes(arrays[P._HEADER_KEY]).decode())
+    header["version"] = 1
+    arrays[P._HEADER_KEY] = np.frombuffer(
+        json.dumps(header).encode(), dtype=np.uint8
+    )
+    with open(path, "wb") as f:
+        np.savez_compressed(f, **arrays)
+
+
+def test_v1_pre_widening_checkpoint_rejected_with_explicit_error(tmp_path):
+    """A checkpoint whose ring checksums are the pre-widening uint32[depth]
+    (format v1, old layout) must fail with a message naming the
+    incompatibility, not a generic per-leaf shape mismatch (ADVICE r2:
+    restore_latest would otherwise walk every old checkpoint failing each
+    one opaquely)."""
+    from bevy_ggrs_tpu.utils import persistence as P
+
+    path = str(tmp_path / "old.npz")
+    old = {"ring": {"checksums": np.zeros((5,), np.uint32)}}
+    new = {"ring": {"checksums": np.zeros((5, 2), np.uint32)}}
+    P.save_checkpoint(path, old)
+    _rewrite_as_v1(path)
+    with pytest.raises(ValueError, match="predates 64-bit checksums"):
+        P.load_checkpoint(path, new)
+
+
+def test_v1_current_layout_checkpoint_still_loads(tmp_path):
+    """The widening shipped before the format-version bump, so checkpoints
+    written by that code are v1 WITH the current layout — they must load
+    (code-review r3: a blanket v1 reject would strand every checkpoint
+    saved by the previous HEAD)."""
+    from bevy_ggrs_tpu.utils import persistence as P
+
+    path = str(tmp_path / "mid.npz")
+    tree = {"ring": {"checksums": np.arange(10, dtype=np.uint32).reshape(5, 2)}}
+    P.save_checkpoint(path, tree)
+    _rewrite_as_v1(path)
+    loaded, _ = P.load_checkpoint(path, tree)
+    assert np.array_equal(
+        np.asarray(loaded["ring"]["checksums"]), tree["ring"]["checksums"]
+    )

@@ -363,3 +363,34 @@ class TestLiveSpawnAPI:
         assert not (host(runner.state)["rollback_id"] == 700).any()
         runner.handle_requests(burst(2, [2]))
         assert not (host(runner.state)["rollback_id"] == 700).any()
+
+
+def test_host_spawn_rejects_device_id_space():
+    """Host-minted ids own 0..DEVICE_ID_BASE-1 (ADVICE r2): an id at or
+    above the boundary could later collide with a device-minted projectile
+    id, silently merging two entities' rollback histories."""
+    from bevy_ggrs_tpu.state import DEVICE_ID_BASE
+
+    runner = RollbackRunner(
+        pj.make_schedule(),
+        pj.make_world(1, capacity=8).commit(),
+        max_prediction=4,
+        num_players=1,
+        input_spec=pj.INPUT_SPEC,
+    )
+    for bad in (DEVICE_ID_BASE, DEVICE_ID_BASE + 7, -1):
+        with pytest.raises(ValueError, match="host id space|outside"):
+            runner.spawn(
+                {"position": np.zeros(2, np.float32)}, rollback_id=bad
+            )
+
+
+def test_rollback_id_provider_stops_at_device_boundary():
+    from bevy_ggrs_tpu.app import RollbackIdProvider
+    from bevy_ggrs_tpu.state import DEVICE_ID_BASE
+
+    rip = RollbackIdProvider()
+    rip._next = DEVICE_ID_BASE - 1
+    assert rip.next_id() == DEVICE_ID_BASE - 1
+    with pytest.raises(OverflowError, match="host id space"):
+        rip.next_id()
