@@ -1,0 +1,92 @@
+"""Worker process for the two-process DCN smoke test.
+
+Each of two OS processes owns 4 virtual CPU devices; `jax.distributed`
+rendezvous at a real TCP coordinator makes them one 8-device cluster. The
+worker then drives the REAL multihost path end to end: global [branch]
+mesh (branch blocks host-local, multihost.py layout rule), a speculative
+rollout whose branch axis spans both processes, a cross-process
+confirmed-branch commit (the one collective that rides DCN), and a final
+checksum allgather proving both processes computed the same world.
+
+Usage: python multihost_worker.py <process_id> <num_processes> <port>
+Prints one line: ``MULTIHOST_OK <process_id> <checksum-hex>``.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    import numpy as np
+
+    from bevy_ggrs_tpu.models import box_game
+    from bevy_ggrs_tpu.parallel import multihost
+    from bevy_ggrs_tpu.parallel.speculate import SpeculativeExecutor
+    from bevy_ggrs_tpu.state import checksum, combine64
+
+    got_pid, got_nproc = multihost.initialize(
+        f"127.0.0.1:{port}", nproc, pid
+    )
+    assert (got_pid, got_nproc) == (pid, nproc), (got_pid, got_nproc)
+    assert jax.process_count() == nproc
+    assert len(jax.local_devices()) == 4
+    assert len(jax.devices()) == 4 * nproc
+
+    topo = multihost.process_topology()
+    assert topo["process_index"] == pid
+
+    B, F, P = 8, 4, 2
+    mesh = multihost.global_branch_mesh()
+    schedule = box_game.make_schedule()
+    state = box_game.make_world(P).commit()
+
+    # Every process materializes the same full branch tensor (same seed)
+    # and contributes its local block — the local_branch_slice contract.
+    rng = np.random.RandomState(7)
+    host_bits = rng.randint(0, 16, (B, F, P), dtype=np.uint8)
+    start, stop = multihost.local_branch_slice(B)
+    assert stop - start == B // nproc and start == pid * (B // nproc)
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(mesh, PartitionSpec("branch"))
+    bits = jax.make_array_from_callback(
+        host_bits.shape, sharding, lambda idx: host_bits[idx]
+    )
+
+    ex = SpeculativeExecutor(schedule, B, F, mesh=mesh)
+    res = ex.run(state, 0, bits)
+    # Confirmed-branch commit: branch 5 lives on the OTHER process for
+    # pid 0 — this gather is the cross-DCN collective.
+    ring, final_state = ex.commit(res, 5)
+    cs = combine64(np.asarray(jax.device_get(checksum(final_state))))
+
+    from jax.experimental import multihost_utils
+
+    everyone = multihost_utils.process_allgather(
+        np.asarray([cs & 0xFFFFFFFF, cs >> 32], np.uint32)
+    )
+    assert everyone.shape[0] == nproc
+    for other in range(nproc):
+        assert (everyone[other] == everyone[pid]).all(), (
+            f"checksum divergence across processes: {everyone}"
+        )
+
+    print(f"MULTIHOST_OK {pid} {cs:#x}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

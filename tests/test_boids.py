@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from bevy_ggrs_tpu.models import boids
 from bevy_ggrs_tpu.parallel.sharding import branch_mesh, shard_branch_axis, shard_world
@@ -130,3 +131,66 @@ class TestEntitySharding:
         np.testing.assert_array_equal(
             np.asarray(r1.checksums), np.asarray(r2.checksums)
         )
+
+
+class TestShardMapKernel:
+    """Round-2 weak #7: Pallas kernels ran replicated under GSPMD (a custom
+    call cannot be partitioned). make_sharded_flock_system wraps them in
+    shard_map: each device runs the kernel on its own row block against an
+    all-gathered column set. Row blocks are independent in the kernel's
+    accumulation, and the gathered column order is the global order, so the
+    sharded run must match the unsharded kernel BITWISE."""
+
+    def _run_session(self, schedule, mesh):
+        from bevy_ggrs_tpu.runner import RollbackRunner
+        from bevy_ggrs_tpu.session import SyncTestSession
+
+        session = SyncTestSession(2, boids.INPUT_SPEC, check_distance=3,
+                                  max_prediction=6)
+        runner = RollbackRunner(
+            schedule, boids.make_world(64, 2).commit(),
+            max_prediction=6, num_players=2, input_spec=boids.INPUT_SPEC,
+            mesh=mesh,
+        )
+        rng = np.random.RandomState(9)
+        cs = []
+        for _ in range(15):
+            for h in range(2):
+                session.add_local_input(h, np.uint8(rng.randint(0, 16)))
+            runner.handle_requests(session.advance_frame(), session)
+            cs.append(combine64(checksum(runner.state)))
+        return cs
+
+    @pytest.mark.parametrize("kernel", ["mxu", "pallas"])
+    def test_sharded_kernel_bitwise_vs_unsharded(self, kernel):
+        if len(jax.devices()) < 2:
+            pytest.skip("needs a multi-device mesh")
+        from bevy_ggrs_tpu.parallel.sharding import branch_mesh
+
+        mesh = branch_mesh(entity_shards=len(jax.devices()))
+        sharded = self._run_session(
+            boids.make_sharded_schedule(mesh, kernel=kernel), mesh
+        )
+        plain = self._run_session(boids.make_schedule(kernel=kernel), None)
+        assert sharded == plain
+
+    def test_sharded_kernel_state_distributed(self):
+        if len(jax.devices()) < 2:
+            pytest.skip("needs a multi-device mesh")
+        from bevy_ggrs_tpu.parallel.sharding import branch_mesh
+        from bevy_ggrs_tpu.runner import RollbackRunner
+        from bevy_ggrs_tpu.session import SyncTestSession
+
+        mesh = branch_mesh(entity_shards=len(jax.devices()))
+        runner = RollbackRunner(
+            boids.make_sharded_schedule(mesh), boids.make_world(64, 2).commit(),
+            max_prediction=6, num_players=2, input_spec=boids.INPUT_SPEC,
+            mesh=mesh,
+        )
+        session = SyncTestSession(2, boids.INPUT_SPEC, check_distance=3,
+                                  max_prediction=6)
+        for _ in range(8):
+            for h in range(2):
+                session.add_local_input(h, np.uint8(0))
+            runner.handle_requests(session.advance_frame(), session)
+        assert not runner.state.components["position"].sharding.is_fully_replicated

@@ -166,3 +166,81 @@ def test_flock_pallas_step_close_and_deterministic():
     # Bitwise self-determinism (what SyncTest checks within one path).
     b2 = pallas_step(state, inputs)
     assert combine64(checksum(b)) == combine64(checksum(b2))
+
+
+# ---------------------------------------------------------------------------
+# MXU kernel variant (feature-major matmul reductions)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [64, 200, 300])
+def test_pairwise_mxu_matches_xla(n):
+    """bf16 hi/lo-split matmul reductions: ~4e-4 relative to the force
+    scale vs the f32 paths (documented tolerance — the masks themselves are
+    f32-exact, so no discrete neighbor flips, only summation rounding)."""
+    from bevy_ggrs_tpu.ops.pairwise import pairwise_force_rows_mxu2
+
+    pos, vel, active = _random_flock(n, seed=n, inactive_every=7)
+    got = pairwise_force_rows_mxu2(
+        pos, vel, pos, vel, active, active, col_block=128, **_KPARAMS
+    )
+    want = boids.pairwise_force_rows(pos, vel, pos, vel, active, active)
+    scale = np.abs(np.asarray(want)).max()
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=max(1e-3 * scale, 1e-6)
+    )
+    assert not np.any(np.asarray(got)[::7])  # inactive rows exactly zero
+
+
+def test_pairwise_mxu_row_subset_and_vmap():
+    from bevy_ggrs_tpu.ops.pairwise import pairwise_force_rows_mxu2
+
+    pos, vel, active = _random_flock(128, seed=5)
+    got = pairwise_force_rows_mxu2(
+        pos[32:64], vel[32:64], pos, vel, active[32:64], active,
+        col_block=128, **_KPARAMS,
+    )
+    want = boids.pairwise_force_rows(
+        pos[32:64], vel[32:64], pos, vel, active[32:64], active
+    )
+    scale = np.abs(np.asarray(want)).max()
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=max(1e-3 * scale, 1e-6)
+    )
+
+    batches = [_random_flock(96, seed=s) for s in range(2)]
+    bp = jnp.stack([b[0] for b in batches])
+    bv = jnp.stack([b[1] for b in batches])
+    ba = jnp.stack([b[2] for b in batches])
+
+    def one(p, v, a):
+        return pairwise_force_rows_mxu2(
+            p, v, p, v, a, a, col_block=128, **_KPARAMS
+        )
+
+    got = jax.vmap(one)(bp, bv, ba)
+    for i in range(2):
+        want = boids.pairwise_force_rows(
+            bp[i], bv[i], bp[i], bv[i], ba[i], ba[i]
+        )
+        scale = np.abs(np.asarray(want)).max()
+        np.testing.assert_allclose(
+            np.asarray(got[i]), np.asarray(want), atol=max(1e-3 * scale, 1e-6)
+        )
+
+
+def test_flock_mxu_step_close_and_deterministic():
+    state = boids.make_world(200, 2).commit()
+    inputs = make_inputs(jnp.asarray([boids.INPUT_RIGHT, 0], dtype=jnp.uint8))
+    xla_step = boids.make_schedule(kernel="xla")
+    mxu_step = boids.make_schedule(kernel="mxu")
+    a = xla_step(state, inputs)
+    b = mxu_step(state, inputs)
+    np.testing.assert_allclose(
+        np.asarray(a.components["position"]),
+        np.asarray(b.components["position"]),
+        atol=1e-4,
+    )
+    # Bitwise self-determinism (what SyncTest checks within one path).
+    b2 = mxu_step(state, inputs)
+    assert combine64(checksum(b)) == combine64(checksum(b2))
