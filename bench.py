@@ -16,10 +16,10 @@ kernels, 5: 8p 12f×1024b Monte Carlo), the neural_bots and projectiles
 model families, and per-model p50/p99 misprediction-recovery latencies, and
 writes the matrix to ``BENCH_DETAIL.json``; per-config lines go to stderr
 so stdout stays a single machine-readable line. Three timing columns:
-``value`` (blocked latency — includes this host's full round trip),
-``sustained_ms`` (pipelined dispatches), and ``device_ms`` (RTT-canceled
-K-slope — pure device time; the authoritative hardware number when the
-remote-TPU tunnel degrades the other two, see ``host_device_rtt_ms``).
+``value`` (RTT-canceled K-slope — pure device time; the authoritative
+hardware number, stable across tunnel states), ``latency_ms`` (blocked —
+includes this host's full round trip), and ``sustained_ms`` (pipelined
+dispatches); interpret the host columns via ``host_device_rtt_ms``.
 Each matrix config runs in its OWN subprocess (``--config NAME``) — configs
 sharing one process inflate each other 3-5x via accumulated device buffers /
 allocator pressure (observed: 0.6 ms fresh vs 123 ms after five configs).
