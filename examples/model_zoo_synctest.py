@@ -1,12 +1,13 @@
 #!/usr/bin/env python
 """SyncTest determinism harness for the extension models (boids,
-neural_bots) — the box_game CLIs cover reference parity; this drives the
-entity-scaling and MXU model families through the same forced-rollback
-machinery.
+neural_bots, projectiles) — the box_game CLIs cover reference parity; this
+drives the entity-scaling, MXU, and dynamic-lifecycle model families
+through the same forced-rollback machinery.
 
     python examples/model_zoo_synctest.py --model boids --entities 512 \
-        --check-distance 5 --frames 120
+        --check-distance 5 --frames 120 --kernel mxu
     python examples/model_zoo_synctest.py --model neural_bots --platform tpu
+    python examples/model_zoo_synctest.py --model projectiles
 """
 
 import argparse
@@ -33,7 +34,11 @@ def main() -> int:
     parser.add_argument("--num-players", type=int, default=2)
     parser.add_argument("--check-distance", type=int, default=4)
     parser.add_argument("--pallas", action="store_true",
-                        help="boids: use the Pallas force kernel")
+                        help="boids: use the VPU Pallas force kernel")
+    parser.add_argument("--kernel", choices=["xla", "pallas", "mxu"],
+                        default=None,
+                        help="boids force kernel (mxu = matmul reductions, "
+                             "fastest single-chip; overrides --pallas)")
     add_common_args(parser)
     args = parser.parse_args()
     force_platform(args.platform)
@@ -45,7 +50,8 @@ def main() -> int:
 
     if args.model == "boids":
         model = boids
-        schedule = boids.make_schedule(use_pallas=args.pallas)
+        schedule = boids.make_schedule(use_pallas=args.pallas,
+                                       kernel=args.kernel)
         world = boids.make_world(args.entities, args.num_players)
     elif args.model == "projectiles":
         model = projectiles
