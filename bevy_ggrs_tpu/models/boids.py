@@ -282,10 +282,10 @@ def make_sharded_flock_system(mesh, entity_axis: str = "entity",
     its own row block — the row-subset contract the kernels already expose
     for exactly this (``pairwise_force_rows*(row_*, all_*)``).
 
-    Scope: the mesh must carry every axis in ``mesh.axis_names`` here, so
-    use a 1D entity mesh (the entity-sharded serial session path, dryrun
-    §3). The 2D branch×entity speculative path keeps the XLA kernel, which
-    GSPMD partitions on both axes."""
+    Works in BOTH executors: the entity-sharded serial session (1D entity
+    mesh, dryrun §4) and the vmapped SpeculativeExecutor on a 2D
+    branch×entity mesh (shard_map under vmap — bitwise-equal to the
+    unsharded kernel, `tests/test_boids.py::TestShardMapSpeculative`)."""
     from jax.sharding import PartitionSpec as P
 
     from bevy_ggrs_tpu.ops.pairwise import (
