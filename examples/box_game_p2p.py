@@ -58,6 +58,12 @@ def main() -> int:
     parser.add_argument("--resume", action="store_true",
                         help="restore the newest checkpoint from "
                              "--checkpoint-dir before joining")
+    parser.add_argument("--interactive", action="store_true",
+                        help="read the local player's input from the "
+                             "keyboard (W/A/S/D, raw-mode TTY) instead of "
+                             "the scripted bitmask — the reference's own "
+                             "input model (box_game.rs:61-78); requires a "
+                             "TTY stdin, falls back to scripted otherwise")
     add_common_args(parser)
     args = parser.parse_args()
     force_platform(args.platform)
@@ -87,7 +93,27 @@ def main() -> int:
     # Build (and JIT-compile) the app BEFORE binding the socket, so the
     # handshake starts only when we can actually service it.
     inst = Instruments(args)
-    app = build_app(num_players, args.max_prediction, args.fps, scripted_input,
+    keys = None
+    input_fn = scripted_input
+    if args.interactive:
+        from box_game_interactive import TtyKeys
+
+        keys = TtyKeys()
+        if keys.is_tty:
+            def input_fn(handle, app):
+                # Keyboard drives the FIRST local handle only; further
+                # local slots (--players localhost localhost) stay
+                # scripted — one keyboard cannot be two players, and
+                # calling bits() per handle would age the hold windows
+                # N-fold. poll() happens once per render frame below.
+                if handle == app.session.local_player_handles()[0]:
+                    return keys.bits()
+                return scripted_input(handle, app)
+        else:
+            print("[interactive] stdin is not a TTY; using scripted input",
+                  file=sys.stderr)
+            keys = None
+    app = build_app(num_players, args.max_prediction, args.fps, input_fn,
                     speculation=args.speculate, metrics=inst.metrics)
     socket = UdpSocket.bind_to_port(args.local_port)
     session = builder.start_p2p_session(socket)
@@ -109,10 +135,16 @@ def main() -> int:
             else:
                 print("[resume] no usable checkpoint; starting fresh")
 
+    import contextlib
+
     dt = 1.0 / args.fps
-    with inst:
+    with inst, (keys if keys is not None else contextlib.nullcontext()):
         for _ in range(args.frames):
             t0 = time.monotonic()
+            if keys is not None:
+                keys.poll()
+                if keys.quit:
+                    break
             app.update()
             if mgr is not None and session.current_state().name == "RUNNING":
                 mgr.maybe_save(app.stage.runner, session=session)
