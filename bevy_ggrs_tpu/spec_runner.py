@@ -239,11 +239,13 @@ class SpeculativeRollbackRunner(RollbackRunner):
         seed: int = 0,
         branch_values=None,
         attest: bool = True,
+        mesh=None,
+        entity_axis: str = "entity",
         **kwargs,
     ):
         super().__init__(
             schedule, initial_state, max_prediction, num_players, input_spec,
-            **kwargs,
+            mesh=mesh, entity_axis=entity_axis, **kwargs,
         )
         self.spec_frames = int(spec_frames or max_prediction)
         self.num_branches = int(num_branches)
@@ -268,8 +270,17 @@ class SpeculativeRollbackRunner(RollbackRunner):
         # sticky random sampler, whose measured hit rate was 0/35 where
         # the structured tree hit 35/35). Pass ``sampler`` to override.
         self._sampler = sampler
+        # A meshed runner speculates on the same mesh: the branch axis is
+        # laid out data-parallel over it and — matching the serial
+        # executor's layout — the world's entity axis stays split, so live
+        # speculation scales with the session instead of silently running
+        # replicated on one device. self.state is already entity-sharded
+        # by the base constructor, making it the right sharding template.
+        # (SpeculativeExecutor ignores entity_axis/state_template when
+        # mesh is None.)
         self._spec = SpeculativeExecutor(
-            schedule, self.num_branches, self.spec_frames
+            schedule, self.num_branches, self.spec_frames,
+            mesh=mesh, entity_axis=entity_axis, state_template=self.state,
         )
         self._key = jax.random.PRNGKey(seed)
         self._result: Optional[SpecResult] = None

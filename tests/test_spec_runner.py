@@ -287,3 +287,66 @@ def test_loopback_session_equivalence():
     assert cs1 == cs2
     spec_runner = spec_peers[0][1]
     assert spec_runner.rollbacks_total > 0  # rollbacks actually happened
+
+
+def test_meshed_live_speculation_equivalent_and_distributed():
+    """A SpeculativeRollbackRunner built with a mesh lays the branch axis
+    over it for LIVE speculation (not just the standalone executor) and
+    keeps the world entity-sharded — and the P2P outcome is bitwise the
+    unmeshed universe's."""
+    import jax
+
+    from bevy_ggrs_tpu.parallel.sharding import branch_mesh
+    from tests.test_p2p import (
+        FPS_DT, common_confirmed_checksums, make_pair, scripted_input,
+    )
+    from bevy_ggrs_tpu.session import PredictionThreshold, SessionState
+    from bevy_ggrs_tpu.transport.loopback import LoopbackNetwork
+
+    if len(jax.devices()) < 2:
+        import pytest
+
+        pytest.skip("needs a multi-device mesh")
+
+    def drive(mesh):
+        net = LoopbackNetwork(latency=2.5 * FPS_DT, seed=21)
+        peers = make_pair(net, max_prediction=8)
+        session0, _ = peers[0]
+        spec = SpeculativeRollbackRunner(
+            box_game.make_schedule(), box_game.make_world(2).commit(),
+            max_prediction=8, num_players=2, input_spec=box_game.INPUT_SPEC,
+            num_branches=16, spec_frames=8, seed=3, mesh=mesh,
+        )
+        peers[0] = (session0, spec)
+        for _ in range(50):
+            net.advance(FPS_DT)
+            for session, runner in peers:
+                session.poll_remote_clients()
+                if session.current_state() != SessionState.RUNNING:
+                    continue
+                for h in session.local_player_handles():
+                    session.add_local_input(
+                        h, scripted_input(h, session.current_frame)
+                    )
+                try:
+                    requests = session.advance_frame()
+                except PredictionThreshold:
+                    continue
+                runner.handle_requests(requests, session)
+                if isinstance(runner, SpeculativeRollbackRunner):
+                    runner.speculate(session.confirmed_frame(), session)
+        return peers, spec
+
+    mesh = branch_mesh()  # all devices on the branch axis
+    meshed_peers, meshed_spec = drive(mesh)
+    plain_peers, _ = drive(None)
+
+    # Live rollouts really were distributed over the mesh.
+    assert meshed_spec._result is not None
+    leaf = meshed_spec._result.checksums
+    assert not leaf.sharding.is_fully_replicated
+    assert meshed_spec.rollbacks_total > 0
+
+    f1, cs1 = common_confirmed_checksums(meshed_peers)
+    f2, cs2 = common_confirmed_checksums(plain_peers)
+    assert f1 and f1 == f2 and cs1 == cs2
