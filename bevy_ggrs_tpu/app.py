@@ -161,6 +161,8 @@ class GGRSStage:
         metrics=None,
         speculation: Optional[int] = None,
         speculation_opts: Optional[dict] = None,
+        mesh=None,
+        entity_axis: str = "entity",
     ):
         from bevy_ggrs_tpu.utils.metrics import null_metrics
 
@@ -178,6 +180,8 @@ class GGRSStage:
                 input_spec=input_spec,
                 num_branches=speculation,
                 metrics=self.metrics,
+                mesh=mesh,
+                entity_axis=entity_axis,
                 **(speculation_opts or {}),
             )
         else:
@@ -188,6 +192,8 @@ class GGRSStage:
                 num_players=num_players,
                 input_spec=input_spec,
                 metrics=self.metrics,
+                mesh=mesh,
+                entity_axis=entity_axis,
             )
         self._clock = clock if clock is not None else _time.monotonic
         # Compile the rollout executable now, before any session handshake:
@@ -299,6 +305,8 @@ class GGRSPlugin:
         self.metrics = None
         self.speculation: Optional[int] = None
         self.speculation_opts: Optional[dict] = None
+        self.mesh = None
+        self.entity_axis = "entity"
 
     def with_update_frequency(self, fps: int) -> "GGRSPlugin":
         self.update_frequency = int(fps)
@@ -356,6 +364,16 @@ class GGRSPlugin:
         self.metrics = metrics
         return self
 
+    def with_mesh(self, mesh, entity_axis: str = "entity") -> "GGRSPlugin":
+        """Run the session's world, snapshot ring, and (with speculation)
+        live rollouts sharded over ``mesh``: the entity/capacity axis
+        splits on ``entity_axis``, speculative branches lay out
+        data-parallel over the mesh's branch axis. The scale-out analog
+        the reference lacks (survey §2.3-2.4)."""
+        self.mesh = mesh
+        self.entity_axis = entity_axis
+        return self
+
     def with_speculation(
         self, num_branches: int, branch_values=None, attest: bool = True
     ) -> "GGRSPlugin":
@@ -398,6 +416,8 @@ class GGRSPlugin:
             metrics=self.metrics,
             speculation=self.speculation,
             speculation_opts=self.speculation_opts,
+            mesh=self.mesh,
+            entity_axis=self.entity_axis,
         )
         attestation = getattr(app.stage.runner, "attestation", None)
         if attestation is not None and not attestation.ok:
