@@ -8,8 +8,14 @@ rollout whose branch axis spans both processes, a cross-process
 confirmed-branch commit (the one collective that rides DCN), and a final
 checksum allgather proving both processes computed the same world.
 
+Two phases: (1) a branch-sharded speculative rollout with a cross-process
+confirmed-branch commit; (2) a live SyncTest session in SPMD lockstep with
+the world/ring entity-sharded across the processes (every rollback a
+cross-DCN collective).
+
 Usage: python multihost_worker.py <process_id> <num_processes> <port>
-Prints one line: ``MULTIHOST_OK <process_id> <checksum-hex>``.
+Prints one line: ``MULTIHOST_OK <process_id> <rollout-checksum-hex>
+live=<live-session-checksum-hex>``.
 """
 
 import os
@@ -85,7 +91,46 @@ def main() -> None:
             f"checksum divergence across processes: {everyone}"
         )
 
-    print(f"MULTIHOST_OK {pid} {cs:#x}", flush=True)
+    # --- Phase 2: a LIVE session spanning both processes. Multi-controller
+    # SPMD requires every process to issue the same jit calls in lockstep;
+    # the sound multihost session model (multihost.py docstring) is
+    # deterministic replication of the host-side protocol — here a
+    # SyncTest whose scripted inputs are identical on both processes, so
+    # both emit identical request lists while the runner's world + ring
+    # live SHARDED across the two processes' devices (the entity axis
+    # spans DCN; every rollback's fused scan runs as cross-process
+    # collectives).
+    from bevy_ggrs_tpu.runner import RollbackRunner
+    from bevy_ggrs_tpu.session import SyncTestSession
+
+    mesh2 = multihost.global_branch_mesh(entity_shards=len(jax.devices()))
+    session = SyncTestSession(
+        P, box_game.INPUT_SPEC, check_distance=2, max_prediction=4
+    )
+    runner = RollbackRunner(
+        schedule, box_game.make_world(P).commit(),
+        max_prediction=4, num_players=P, input_spec=box_game.INPUT_SPEC,
+        mesh=mesh2,
+    )
+    rng2 = np.random.RandomState(42)  # same stream on both processes
+    for _ in range(10):
+        for h in range(P):
+            session.add_local_input(h, np.uint8(rng2.randint(0, 16)))
+        runner.handle_requests(session.advance_frame(), session)
+    assert runner.frame == 10
+    assert not runner.state.components[
+        "translation"
+    ].sharding.is_fully_replicated
+    live_cs = combine64(np.asarray(jax.device_get(checksum(runner.state))))
+    everyone2 = multihost_utils.process_allgather(
+        np.asarray([live_cs & 0xFFFFFFFF, live_cs >> 32], np.uint32)
+    )
+    for other in range(nproc):
+        assert (everyone2[other] == everyone2[pid]).all(), (
+            f"live-session divergence across processes: {everyone2}"
+        )
+
+    print(f"MULTIHOST_OK {pid} {cs:#x} live={live_cs:#x}", flush=True)
 
 
 if __name__ == "__main__":

@@ -93,6 +93,8 @@ class TestTwoProcessDCN:
             lines = [l for l in out.splitlines() if l.startswith("MULTIHOST_OK")]
             assert lines, f"worker {i} printed no OK line:\n{out[-3000:]}"
             oks.append(lines[0].split())
-        # Same checksum on both processes (the workers also assert this
-        # internally via allgather — this is the out-of-band double check).
+        # Same checksums on both processes, for BOTH phases (the workers
+        # also assert this internally via allgather — this is the
+        # out-of-band double check).
         assert oks[0][2] == oks[1][2]
+        assert oks[0][3] == oks[1][3]  # live=<hex> token, phase 2
