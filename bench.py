@@ -300,7 +300,7 @@ def _recovery_case(model: str, frames: int, branches: int, rtt_ms: float):
     from pipelined batches so the tunnel RTT amortizes instead of
     masquerading as recovery cost."""
     import jax.numpy as jnp
-    from bevy_ggrs_tpu.models import boids, box_game, projectiles
+    from bevy_ggrs_tpu.models import boids, box_game, neural_bots, projectiles
     from bevy_ggrs_tpu.parallel.speculate import SpeculativeExecutor
     from bevy_ggrs_tpu.rollout import RolloutExecutor
     from bevy_ggrs_tpu.spec_runner import _absorb
@@ -314,6 +314,9 @@ def _recovery_case(model: str, frames: int, branches: int, rtt_ms: float):
         players = 4
         schedule = projectiles.make_schedule()
         state = projectiles.make_world(players, 64).commit()
+    elif model == "neural_bots":
+        schedule = neural_bots.make_schedule()
+        state = neural_bots.make_world(512, 2).commit()
     else:
         schedule = box_game.make_schedule()
         state = box_game.make_world(2).commit()
@@ -487,6 +490,7 @@ _RECOVERY_CONFIGS = {
     "box_game_recovery_8f_spec_vs_serial": ("box_game", 8, 32),
     "boids_recovery_8f_spec_vs_serial": ("boids", 8, 32),
     "projectiles_recovery_8f_spec_vs_serial": ("projectiles", 8, 32),
+    "neural_bots_recovery_8f_spec_vs_serial": ("neural_bots", 8, 32),
 }
 
 
