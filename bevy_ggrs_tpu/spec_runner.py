@@ -284,11 +284,28 @@ class SpeculativeRollbackRunner(RollbackRunner):
         )
         self._key = jax.random.PRNGKey(seed)
         self._result: Optional[SpecResult] = None
+        # Dispatch dedup: (anchor, last/known bytes) of the live rollout —
+        # ticks where the confirmed frontier hasn't moved and no new
+        # inputs confirmed inside the span would re-dispatch an identical
+        # rollout (the anchor state is ring-fixed once the frontier lags).
+        self._spec_sig = None
         self._input_log = {}  # as-used inputs, frame -> bits (host)
+        self.spec_dispatches_skipped = 0
         self.spec_hits = 0
         self.spec_partial_hits = 0
         self.spec_misses = 0
         self.rollback_frames_recovered_total = 0
+
+    def invalidate_speculation(self) -> None:
+        """Drop every speculative transient: the pending rollout, its
+        dedup signature, and the as-used input log. MUST be called when
+        the runner's ring/state/frame are replaced from outside the
+        request protocol (checkpoint restore does this automatically) —
+        a rollout computed from the pre-restore world must never commit
+        into the post-restore one."""
+        self._result = None
+        self._spec_sig = None
+        self._input_log.clear()
 
     def warmup(self) -> None:
         """Compile the serial executor AND the speculative pipeline
@@ -355,6 +372,26 @@ class SpeculativeRollbackRunner(RollbackRunner):
         if last is None:
             last = self.input_spec.zeros_np(self.num_players)
         known, known_mask = self._known_inputs(anchor, session)
+        if anchor < self.frame and self._sampler is None:
+            # The anchor state is ring-fixed (a past frame) and the
+            # structured tree is deterministic in (anchor, last, known),
+            # so a rollout from the same signature is the SAME rollout —
+            # skip the redundant device dispatch. (When anchor ==
+            # self.frame the anchor state is the live state, which moves
+            # every tick; with a random sampler each dispatch draws FRESH
+            # branches, whose compounding hit probability the skip would
+            # destroy — no dedup in either case.)
+            sig = (
+                anchor, np.asarray(last).tobytes(),
+                known.tobytes(), known_mask.tobytes(),
+            )
+            if self._result is not None and sig == self._spec_sig:
+                self.spec_dispatches_skipped += 1
+                self.metrics.count("spec_dispatches_skipped")
+                return
+            self._spec_sig = sig
+        else:
+            self._spec_sig = None
         if self._sampler is not None:
             self._key, sub = jax.random.split(self._key)
             bits = enumerate_branches(

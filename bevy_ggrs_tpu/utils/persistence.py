@@ -183,6 +183,12 @@ def restore_runner(path: str, runner, session=None) -> Dict:
     runner.frame = frame
     runner.rollbacks_total = int(meta.get("rollbacks_total", 0))
     runner.rollback_frames_total = int(meta.get("rollback_frames_total", 0))
+    # Speculative transients (pending rollout, dedup signature, as-used
+    # input log) describe the PRE-restore world — a later rollback must
+    # not commit branch states simulated from it.
+    invalidate = getattr(runner, "invalidate_speculation", None)
+    if invalidate is not None:
+        invalidate()
     return meta
 
 

@@ -350,3 +350,84 @@ def test_meshed_live_speculation_equivalent_and_distributed():
     f1, cs1 = common_confirmed_checksums(meshed_peers)
     f2, cs2 = common_confirmed_checksums(plain_peers)
     assert f1 and f1 == f2 and cs1 == cs2
+
+
+def test_speculate_dedups_identical_redispatch():
+    """Ticks where the confirmed frontier hasn't moved and no new inputs
+    confirmed inside the span must NOT re-dispatch the (identical) rollout;
+    anything that changes the prediction inputs must."""
+
+    class FakeSession:
+        def __init__(self):
+            self.inputs = {}
+
+        def confirmed_input(self, handle, frame):
+            return self.inputs.get((handle, frame))
+
+    _, spec = make_runners(num_branches=4, spec_frames=4)
+    session = FakeSession()
+    # Advance to frame 4 so a past anchor exists.
+    for f in range(4):
+        spec.handle_requests(step_requests(f, [f, f + 1]), None)
+
+    spec.speculate(1, session)  # anchor 2 < frame 4: dedup eligible
+    first = spec._result
+    assert first is not None and spec.spec_dispatches_skipped == 0
+    spec.speculate(1, session)  # identical tick -> skipped
+    assert spec.spec_dispatches_skipped == 1
+    assert spec._result is first
+    # A newly confirmed input inside the span changes the signature.
+    session.inputs[(1, 3)] = np.uint8(9)
+    spec.speculate(1, session)
+    assert spec.spec_dispatches_skipped == 1
+    assert spec._result is not first
+    # Frontier advance changes the anchor -> re-dispatch.
+    second = spec._result
+    spec.speculate(2, session)
+    assert spec._result is not second
+    # Live-state anchor (anchor == frame) never dedups: state moves.
+    spec.speculate(3, session)
+    live1 = spec._result
+    spec.speculate(3, session)
+    assert spec._result is not live1
+
+
+def test_restore_invalidates_speculative_transients(tmp_path):
+    """A checkpoint restore replaces ring/state/frame from outside the
+    request protocol; the pending rollout, its dedup signature, and the
+    as-used input log describe the pre-restore world and must be dropped
+    (code-review r3: the dedup otherwise serves a pre-restore rollout
+    indefinitely)."""
+    from bevy_ggrs_tpu.utils.persistence import restore_runner, save_runner
+
+    _, spec = make_runners(num_branches=4, spec_frames=4)
+    for f in range(3):
+        spec.handle_requests(step_requests(f, [f, f + 1]), None)
+    path = str(tmp_path / "ck.npz")
+    save_runner(path, spec)
+    spec.handle_requests(step_requests(3, [3, 4]), None)
+    spec.speculate(2)
+    assert spec._result is not None and spec._input_log
+
+    restore_runner(path, spec)
+    assert spec._result is None
+    assert spec._spec_sig is None
+    assert not spec._input_log
+    assert spec.frame == 3
+
+
+def test_random_sampler_path_never_dedups():
+    """Each sampler dispatch draws fresh Monte Carlo branches — skipping a
+    'same-signature' tick would collapse the compounding hit probability,
+    so the dedup must bypass sampler-based runners entirely."""
+    from bevy_ggrs_tpu.parallel.speculate import bitmask_sampler
+
+    _, spec = make_runners(num_branches=4, spec_frames=4)
+    spec._sampler = bitmask_sampler()
+    for f in range(4):
+        spec.handle_requests(step_requests(f, [f, f + 1]), None)
+    spec.speculate(1)
+    first = spec._result
+    spec.speculate(1)
+    assert spec._result is not first  # fresh draw, no skip
+    assert spec.spec_dispatches_skipped == 0
