@@ -163,6 +163,7 @@ class GGRSStage:
         speculation_opts: Optional[dict] = None,
         mesh=None,
         entity_axis: str = "entity",
+        branch_axis: str = "branch",
     ):
         from bevy_ggrs_tpu.utils.metrics import null_metrics
 
@@ -182,6 +183,7 @@ class GGRSStage:
                 metrics=self.metrics,
                 mesh=mesh,
                 entity_axis=entity_axis,
+                branch_axis=branch_axis,
                 **(speculation_opts or {}),
             )
         else:
@@ -307,6 +309,7 @@ class GGRSPlugin:
         self.speculation_opts: Optional[dict] = None
         self.mesh = None
         self.entity_axis = "entity"
+        self.branch_axis = "branch"
 
     def with_update_frequency(self, fps: int) -> "GGRSPlugin":
         self.update_frequency = int(fps)
@@ -364,14 +367,19 @@ class GGRSPlugin:
         self.metrics = metrics
         return self
 
-    def with_mesh(self, mesh, entity_axis: str = "entity") -> "GGRSPlugin":
+    def with_mesh(
+        self, mesh, entity_axis: str = "entity", branch_axis: str = "branch"
+    ) -> "GGRSPlugin":
         """Run the session's world, snapshot ring, and (with speculation)
         live rollouts sharded over ``mesh``: the entity/capacity axis
         splits on ``entity_axis``, speculative branches lay out
-        data-parallel over the mesh's branch axis. The scale-out analog
-        the reference lacks (survey §2.3-2.4)."""
+        data-parallel over the mesh's ``branch_axis``. A speculative
+        session therefore needs a 2D (branch × entity) mesh; the runner
+        rejects a mesh missing the branch axis at construction. The
+        scale-out analog the reference lacks (survey §2.3-2.4)."""
         self.mesh = mesh
         self.entity_axis = entity_axis
+        self.branch_axis = branch_axis
         return self
 
     def with_speculation(
@@ -418,6 +426,7 @@ class GGRSPlugin:
             speculation_opts=self.speculation_opts,
             mesh=self.mesh,
             entity_axis=self.entity_axis,
+            branch_axis=self.branch_axis,
         )
         attestation = getattr(app.stage.runner, "attestation", None)
         if attestation is not None and not attestation.ok:

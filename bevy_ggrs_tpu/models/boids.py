@@ -95,6 +95,20 @@ def make_world(
     return world
 
 
+def _kernel_params() -> dict:
+    """The five flocking constants every Pallas/MXU kernel call shares —
+    built in one place (read at call time, not import time) so the
+    sharded and unsharded paths can never silently diverge on a tuning
+    change, which would void the allclose-across-paths contract."""
+    return dict(
+        neighbor_radius=float(NEIGHBOR_RADIUS),
+        separation_radius=float(SEPARATION_RADIUS),
+        w_separation=float(W_SEPARATION),
+        w_alignment=float(W_ALIGNMENT),
+        w_cohesion=float(W_COHESION),
+    )
+
+
 def flock_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
     """One flocking step: O(N²) pairwise separation/alignment/cohesion
     forces + leader steering from player inputs, then clamped integration.
@@ -120,12 +134,7 @@ def flock_system_pallas(state: WorldState, inputs: PlayerInputs) -> WorldState:
 
     def forces(pos, vel, active):
         return pairwise_force_rows_pallas(
-            pos, vel, pos, vel, active, active,
-            neighbor_radius=float(NEIGHBOR_RADIUS),
-            separation_radius=float(SEPARATION_RADIUS),
-            w_separation=float(W_SEPARATION),
-            w_alignment=float(W_ALIGNMENT),
-            w_cohesion=float(W_COHESION),
+            pos, vel, pos, vel, active, active, **_kernel_params()
         )
 
     return _flock_step(state, inputs, forces)
@@ -137,21 +146,30 @@ def flock_system_mxu(state: WorldState, inputs: PlayerInputs) -> WorldState:
     neighborhood sums become feature-major bf16 matmuls with f32
     accumulation (hi/lo-split operands, ~4e-4 relative error vs the f32
     paths), while d2 and the membership masks stay f32 so borderline pairs
-    classify identically on all paths. Measured ~2x the VPU Pallas kernel
-    at the BASELINE config-4 shape (B=128, N=1024) — the path that puts 1k
-    boids x 128 branches x 8 frames under one 16 ms render frame. Same
-    session caveat as the other kernels: allclose across paths, bitwise
-    only within one."""
-    from bevy_ggrs_tpu.ops.pairwise import pairwise_force_rows_mxu2
+    classify identically on all paths. The path that puts 1k boids x 128
+    branches x 8 frames under one 16 ms render frame (round-4 measured:
+    ~6.0 ms, was 8.5 in round 3 — the XLA row-operand relayout was the
+    gap; see the kernel docstrings). At N >= 4096 the square all-vs-all
+    shape dispatches to the symmetry-halved triangle kernel
+    (:func:`~bevy_ggrs_tpu.ops.pairwise.pairwise_force_square_mxu_tri`,
+    ~25% faster at 4k and approaching 2x as N grows); below that the
+    block grid is too small to amortize the triangle's col-side work.
+    Same session caveat as the other kernels: allclose across paths,
+    bitwise only within one — and the two MXU shapes are themselves
+    distinct float paths, chosen statically by N, so every executable at
+    a given world size uses exactly one."""
+    from bevy_ggrs_tpu.ops.pairwise import (
+        pairwise_force_rows_mxu2,
+        pairwise_force_square_mxu_tri,
+    )
+
+    params = _kernel_params()
 
     def forces(pos, vel, active):
+        if pos.shape[0] >= 4096:  # static shape: one kernel per executable
+            return pairwise_force_square_mxu_tri(pos, vel, active, **params)
         return pairwise_force_rows_mxu2(
-            pos, vel, pos, vel, active, active,
-            neighbor_radius=float(NEIGHBOR_RADIUS),
-            separation_radius=float(SEPARATION_RADIUS),
-            w_separation=float(W_SEPARATION),
-            w_alignment=float(W_ALIGNMENT),
-            w_cohesion=float(W_COHESION),
+            pos, vel, pos, vel, active, active, **params
         )
 
     return _flock_step(state, inputs, forces)
@@ -297,13 +315,7 @@ def make_sharded_flock_system(mesh, entity_axis: str = "entity",
         pairwise_force_rows_mxu2 if kernel == "mxu"
         else pairwise_force_rows_pallas
     )
-    params = dict(
-        neighbor_radius=float(NEIGHBOR_RADIUS),
-        separation_radius=float(SEPARATION_RADIUS),
-        w_separation=float(W_SEPARATION),
-        w_alignment=float(W_ALIGNMENT),
-        w_cohesion=float(W_COHESION),
-    )
+    params = _kernel_params()
 
     def per_shard(p, v, a):  # p: [N/k, 2] — this shard's rows
         all_p = jax.lax.all_gather(p, entity_axis, axis=0, tiled=True)

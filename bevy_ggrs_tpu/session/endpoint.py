@@ -219,10 +219,25 @@ class PeerEndpoint:
         """Count a dropped mixed-version datagram from this peer; after
         VERSION_MISMATCH_THRESHOLD of them, emit one VERSION_MISMATCH event
         so a version-skewed peer surfaces instead of stalling sync forever
-        (the datagrams stay dropped — there is no cross-version parse)."""
+        (the datagrams stay dropped — there is no cross-version parse).
+
+        The event only fires while the peer is failing to progress: still
+        SYNCHRONIZING (the state a version-skewed peer is stuck in at
+        session start), or RUNNING but interrupted (no valid traffic past
+        the notify threshold — the mid-session shape, e.g. a peer that
+        restarted on an upgraded binary). Datagram source addresses are
+        spoofable (plain UDP, no origin auth), so an off-path attacker who
+        knows a peer's addr:port could replay skewed headers; while the
+        real peer is RUNNING healthily those can only be noise, and gating
+        on progress silences that false alarm (round-3 advice #4).
+        Counting continues either way (``network_stats`` exposes it)."""
         self.version_mismatches += 1
+        stalled = (
+            self.state is PeerState.SYNCHRONIZING or self._interrupted
+        )
         if (
             not self._version_mismatch_reported
+            and stalled
             and self.version_mismatches >= VERSION_MISMATCH_THRESHOLD
         ):
             self._version_mismatch_reported = True

@@ -194,6 +194,72 @@ class TestAttestation:
         assert not app.stage.runner.speculation_enabled
 
 
+class TestAttestationCache:
+    """The process-level attestation memo (round-3 verdict weak #6): the
+    verdict is a property of the two XLA executables — schedule, shapes,
+    geometry, backend — so constructing a second runner of the same model
+    must reuse it instead of re-running both executables."""
+
+    def _fresh(self, monkeypatch, counter):
+        import bevy_ggrs_tpu.spec_runner as sr
+
+        monkeypatch.setattr(sr, "_ATTEST_MEMO", {})
+        real = sr.attest_speculation_safety
+
+        def counting(runner, **kw):
+            counter.append(runner)
+            return real(runner, **kw)
+
+        monkeypatch.setattr(sr, "attest_speculation_safety", counting)
+
+    def test_same_model_same_shape_attests_once(self, monkeypatch):
+        calls = []
+        self._fresh(monkeypatch, calls)
+        for _ in range(2):
+            runner = make_spec_runner(box_game, box_game.make_world(2))
+            runner.warmup()
+            assert runner.attestation is not None and runner.attestation.ok
+        assert len(calls) == 1
+
+    def test_different_shape_attests_fresh(self, monkeypatch):
+        calls = []
+        self._fresh(monkeypatch, calls)
+        r1 = make_spec_runner(box_game, box_game.make_world(2))
+        r1.warmup()
+        r2 = make_spec_runner(
+            box_game, box_game.make_world(2), num_branches=16
+        )
+        r2.warmup()
+        assert len(calls) == 2
+
+    def test_different_schedule_closure_attests_fresh(self, monkeypatch):
+        """Two schedules from the same factory share bytecode; the
+        fingerprint must still split them by what the closures capture."""
+        calls = []
+        self._fresh(monkeypatch, calls)
+        for kernel in ("xla", "pallas"):
+            runner = SpeculativeRollbackRunner(
+                boids.make_schedule(kernel=kernel),
+                boids.make_world(32, 2).commit(),
+                max_prediction=8,
+                num_players=2,
+                input_spec=boids.INPUT_SPEC,
+                num_branches=4,
+                spec_frames=4,
+            )
+            runner.warmup()
+        assert len(calls) == 2
+
+    def test_env_var_disables_cache(self, monkeypatch):
+        calls = []
+        self._fresh(monkeypatch, calls)
+        monkeypatch.setenv("GGRS_ATTEST_CACHE", "0")
+        for _ in range(2):
+            runner = make_spec_runner(box_game, box_game.make_world(2))
+            runner.warmup()
+        assert len(calls) == 2
+
+
 class TestProjectilesSpeculation:
     """The round-2 hole: GGRSStage built the runner with default
     branch_values=range(16), so a FIRE (1<<4) press could never be a

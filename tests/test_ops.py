@@ -229,6 +229,54 @@ def test_pairwise_mxu_row_subset_and_vmap():
         )
 
 
+@pytest.mark.parametrize("n,blk", [(256, 128), (300, 128), (512, 128)])
+def test_pairwise_tri_matches_xla(n, blk):
+    """Triangle kernel (symmetry-halved mask work): same tolerance class
+    as the general MXU kernel; the small block forces a multi-block grid
+    so diagonal, off-diagonal, predicated-off, and padded blocks all
+    execute. n=300 exercises column padding inside the triangle."""
+    from bevy_ggrs_tpu.ops.pairwise import pairwise_force_square_mxu_tri
+
+    pos, vel, active = _random_flock(n, seed=n, inactive_every=7)
+    got = pairwise_force_square_mxu_tri(
+        pos, vel, active, block=blk, **_KPARAMS
+    )
+    want = boids.pairwise_force_rows(pos, vel, pos, vel, active, active)
+    scale = np.abs(np.asarray(want)).max()
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=max(1e-3 * scale, 1e-6)
+    )
+    assert not np.any(np.asarray(got)[::7])  # inactive rows exactly zero
+
+
+def test_pairwise_tri_vmap_and_determinism():
+    """The speculative executor runs kernels under vmap: the triangle's
+    full-width col-side scratch and predicated grid must batch correctly,
+    and repeated runs must be bitwise identical (SyncTest property)."""
+    from bevy_ggrs_tpu.ops.pairwise import pairwise_force_square_mxu_tri
+
+    batches = [_random_flock(256, seed=s) for s in range(2)]
+    bp = jnp.stack([b[0] for b in batches])
+    bv = jnp.stack([b[1] for b in batches])
+    ba = jnp.stack([b[2] for b in batches])
+
+    def one(p, v, a):
+        return pairwise_force_square_mxu_tri(p, v, a, block=128, **_KPARAMS)
+
+    got = jax.vmap(one)(bp, bv, ba)
+    for i in range(2):
+        want = boids.pairwise_force_rows(
+            bp[i], bv[i], bp[i], bv[i], ba[i], ba[i]
+        )
+        scale = np.abs(np.asarray(want)).max()
+        np.testing.assert_allclose(
+            np.asarray(got[i]), np.asarray(want),
+            atol=max(1e-3 * scale, 1e-6),
+        )
+    again = jax.vmap(one)(bp, bv, ba)
+    assert np.array_equal(np.asarray(got), np.asarray(again))
+
+
 def test_flock_mxu_step_close_and_deterministic():
     state = boids.make_world(200, 2).commit()
     inputs = make_inputs(jnp.asarray([boids.INPUT_RIGHT, 0], dtype=jnp.uint8))
