@@ -72,6 +72,9 @@ def _load():
     lib.ggrs_qs_input.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_int32, u8p]
     lib.ggrs_qs_input.restype = ctypes.c_int
+    lib.ggrs_qs_confirmed_span.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int32, ctypes.c_int32,
+        u8p, u8p]
     lib.ggrs_qs_discard_before.argtypes = [ctypes.c_void_p, ctypes.c_int32]
     lib.ggrs_qs_reset.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                   ctypes.c_int32, u8p]
@@ -173,6 +176,18 @@ class _NativeQueueView:
         if _lib.ggrs_qs_confirmed(self._qs._ptr, self._h, int(frame), _u8p(flat)):
             return self._qs._decode_one(flat)
         return None
+
+    def confirmed_span(self, lo: int, n: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Confirmed inputs for frames ``lo .. lo+n-1`` in ONE native call:
+        ``(values[n, *shape], mask[n])`` — unconfirmed slots are zeros with
+        mask False. The speculative runner's per-tick bulk query."""
+        flat = np.zeros(n * self._qs._nbytes, dtype=np.uint8)
+        mask = np.zeros(n, dtype=np.uint8)
+        _lib.ggrs_qs_confirmed_span(
+            self._qs._ptr, self._h, int(lo), int(n), _u8p(flat), _u8p(mask)
+        )
+        values = flat.view(self._qs._dtype).reshape((n,) + self._qs._shape)
+        return values, mask.astype(bool)
 
     def input(self, frame: int) -> Tuple[np.ndarray, bool]:
         flat = self._qs._out_flat(1)

@@ -15,6 +15,7 @@
 // C ABI only (ctypes binding in native/core.py — no pybind11). All frame
 // numbers are int32; NULL_FRAME == -1 matches session/common.py.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <deque>
@@ -170,6 +171,25 @@ int ggrs_qs_confirmed(void* p, int handle, int32_t frame, uint8_t* out) {
 
 int ggrs_qs_input(void* p, int handle, int32_t frame, uint8_t* out) {
   return static_cast<QueueSet*>(p)->queues[size_t(handle)].input(frame, out);
+}
+
+// Bulk confirmed-input query for frames [lo, lo+n): out receives n
+// contiguous input payloads (unconfirmed slots untouched), mask[i] = 1
+// where confirmed. One FFI call replaces the speculative runner's
+// per-(frame, player) getter loop — O(F x P) Python/ctypes round trips
+// per tick became O(P).
+void ggrs_qs_confirmed_span(void* p, int handle, int32_t lo, int32_t n,
+                            uint8_t* out, uint8_t* mask) {
+  const Queue& q = static_cast<QueueSet*>(p)->queues[size_t(handle)];
+  std::memset(mask, 0, size_t(n));
+  if (q.inputs.empty()) return;
+  int32_t f0 = std::max(lo, q.base);
+  int32_t f1 = std::min(lo + n - 1, q.last_confirmed);
+  for (int32_t f = f0; f <= f1; ++f) {
+    std::memcpy(out + size_t(f - lo) * size_t(q.input_bytes),
+                q.inputs[size_t(f - q.base)].data(), size_t(q.input_bytes));
+    mask[f - lo] = 1;
+  }
 }
 
 void ggrs_qs_discard_before(void* p, int32_t frame) {

@@ -60,6 +60,7 @@ class SessionBuilder:
         self.catchup_threshold = 8
         self.max_frames_behind = 4
         self.seed = 0
+        self.desync_detection = "auto"
         self._players: Dict[int, PlayerType] = {}
         self._spectators: List[object] = []
 
@@ -104,6 +105,23 @@ class SessionBuilder:
 
     def with_seed(self, seed: int) -> "SessionBuilder":
         self.seed = int(seed)
+        return self
+
+    def with_desync_detection(self, interval_frames) -> "SessionBuilder":
+        """Configure the P2P checksum exchange (the ggrs
+        ``DesyncDetection`` session config, survey §2.2).
+
+        ``interval_frames`` > 0: exchange confirmed-frame checksums every
+        that many frames. ``None`` or <= 0: off — no exchange, no
+        ``DESYNC_DETECTED`` events, and rollback bursts never pay a
+        device->host checksum sync. Unset ("auto", the default): the
+        largest interval not exceeding ``max_prediction``, chosen so the
+        divergent frame is usually still inside the snapshot ring at
+        detection time and ``runner.diagnose_frame(frame)`` can name the
+        diverging component instead of falling back to current-state
+        diffing. Smaller intervals localize desyncs faster but cost a
+        host sync (and a datagram) proportionally more often."""
+        self.desync_detection = interval_frames
         return self
 
     def add_player(self, player: PlayerType, handle: int) -> "SessionBuilder":
@@ -155,6 +173,7 @@ class SessionBuilder:
             fps=self.fps,
             seed=self.seed,
             clock=clock,
+            desync_detection=self.desync_detection,
         )
 
     def start_synctest_session(self) -> SyncTestSession:

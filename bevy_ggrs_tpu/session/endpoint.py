@@ -31,7 +31,9 @@ NUM_SYNC_ROUNDTRIPS = 5
 SYNC_RETRY_INTERVAL = 0.2
 QUALITY_REPORT_INTERVAL = 0.2
 KEEP_ALIVE_INTERVAL = 0.2
-CHECKSUM_REPORT_INTERVAL_FRAMES = 16
+# (Checksum-exchange cadence is session config: P2PSession.desync_interval,
+# set via SessionBuilder.with_desync_detection — the endpoint just carries
+# whatever reports the session hands it.)
 DEFAULT_DISCONNECT_TIMEOUT = 2.0
 DEFAULT_DISCONNECT_NOTIFY_START = 0.5
 # Mismatched-version datagrams from one peer before VERSION_MISMATCH fires

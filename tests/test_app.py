@@ -171,11 +171,11 @@ class TestP2PApp:
             f for f in sa._local_checksums
             if f <= upto and f in sb._local_checksums
         ]
-        # Lazy checksum reporting: only exchange-interval frames are synced
+        # Lazy checksum reporting: only desync-interval frames are synced
         # to the host and stored (wants_checksum) — all of them must agree.
-        from bevy_ggrs_tpu.session.p2p import CHECKSUM_SEND_INTERVAL
+        assert sa.desync_interval == min(16, sa.max_prediction)  # auto
         assert len(common) >= 2
-        assert all(f % CHECKSUM_SEND_INTERVAL == 0 for f in common)
+        assert all(f % sa.desync_interval == 0 for f in common)
         assert all(sa._local_checksums[f] == sb._local_checksums[f] for f in common)
         return apps
 
