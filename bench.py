@@ -714,6 +714,7 @@ def _live_session_case(model: str, speculate: bool, transport: str) -> dict:
 
     tick_ms, tick_sync = [], []
     rollback_tick_ms = []
+    desync_events = 0
     session0, runner0 = peers[0]
     sync_series = metrics.series["checksum_sync_ms"]
 
@@ -745,7 +746,9 @@ def _live_session_case(model: str, speculate: bool, transport: str) -> dict:
             t0 = time.perf_counter()
             n_sync0 = len(sync_series)
             session.poll_remote_clients()
-            session.events()  # drain
+            for ev in session.events():  # drain; the run is also a soak
+                if ev.kind.name == "DESYNC_DETECTED":
+                    desync_events += 1
             if session.current_state() != SessionState.RUNNING:
                 continue
             for h in session.local_player_handles():
@@ -775,9 +778,11 @@ def _live_session_case(model: str, speculate: bool, transport: str) -> dict:
             close()
 
     tick = np.asarray(tick_ms)
-    if tick.size == 0:
+    no_data = tick.size == 0
+    if no_data:
         # Short runs (GGRS_LIVE_FRAMES below the sync handshake length)
-        # record nothing; report that honestly instead of crashing.
+        # record nothing; report zeros WITH zero hit rates — a degenerate
+        # run must not read as a perfect one (frames_driven tells why).
         tick = np.asarray([0.0])
     nosync = tick[~np.asarray(tick_sync, bool)] if len(tick_sync) else tick
     if nosync.size == 0:
@@ -803,13 +808,18 @@ def _live_session_case(model: str, speculate: bool, transport: str) -> dict:
         confirmed_frames=int(session0.confirmed_frame()),
         tick_p50_ms=round(float(np.percentile(tick, 50)), 3),
         tick_p99_ms=round(float(np.percentile(tick, 99)), 3),
-        deadline_hit_rate=round(float((tick <= DEADLINE_MS).mean()), 4),
-        deadline_hit_rate_nosync=round(
-            float((nosync <= DEADLINE_MS).mean()) if nosync.size else 1.0, 4
+        deadline_hit_rate=(
+            0.0 if no_data
+            else round(float((tick <= DEADLINE_MS).mean()), 4)
+        ),
+        deadline_hit_rate_nosync=(
+            0.0 if no_data
+            else round(float((nosync <= DEADLINE_MS).mean()), 4)
         ),
         rollback_ticks=int(rb.size),
         recovery_p50_ms=round(float(np.percentile(rb, 50)), 3) if rb.size else 0.0,
         recovery_p99_ms=round(float(np.percentile(rb, 99)), 3) if rb.size else 0.0,
+        desync_events=int(desync_events),  # a live run is a soak: must be 0
         rollbacks_total=int(runner0.rollbacks_total),
         rollback_frames_resimulated=int(runner0.rollback_frames_total),
         rollback_frames_recovered=int(

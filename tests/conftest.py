@@ -14,6 +14,17 @@ multi-device sharding tests will skip if only one chip is visible).
 
 import os
 
+# Persistent XLA compilation cache: the suite's dominant cost is compiling
+# per-test executables (every runner's schedule closure is a fresh jit
+# entry), and the programs are identical across runs — a warm cache cuts
+# attestation-heavy test files ~3x (measured 28 -> 10 s). Keyed by HLO
+# hash, so stale entries are impossible; delete the dir to force cold.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", "/tmp/bevy_ggrs_tpu_jax_cache"
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+
 if os.environ.get("GGRS_TEST_TPU") != "1":
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
