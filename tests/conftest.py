@@ -14,17 +14,6 @@ multi-device sharding tests will skip if only one chip is visible).
 
 import os
 
-# Persistent XLA compilation cache: the suite's dominant cost is compiling
-# per-test executables (every runner's schedule closure is a fresh jit
-# entry), and the programs are identical across runs — a warm cache cuts
-# attestation-heavy test files ~3x (measured 28 -> 10 s). Keyed by HLO
-# hash, so stale entries are impossible; delete the dir to force cold.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR", "/tmp/bevy_ggrs_tpu_jax_cache"
-)
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
-
 if os.environ.get("GGRS_TEST_TPU") != "1":
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
@@ -36,3 +25,25 @@ if os.environ.get("GGRS_TEST_TPU") != "1":
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compilation cache: the suite's dominant cost is compiling
+# per-test executables (every runner's schedule closure is a fresh jit
+# entry), and the programs are identical across runs — a warm cache cuts
+# attestation-heavy test files ~3x (measured 28 -> 10 s). Keyed by HLO
+# hash, so stale entries are impossible; delete the dir to force cold.
+# NOTE: must go through jax.config.update — sitecustomize imported jax
+# before this file runs, so the env-var forms have already been read.
+import jax  # noqa: E402  (re-import is a no-op; config still mutable)
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/bevy_ggrs_tpu_jax_cache"),
+)
+jax.config.update(
+    "jax_persistent_cache_min_entry_size_bytes",
+    int(os.environ.get("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")),
+)
+jax.config.update(
+    "jax_persistent_cache_min_compile_time_secs",
+    float(os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")),
+)
