@@ -56,6 +56,7 @@ def _load():
     lib.ggrs_qs_new.argtypes = [ctypes.c_int, ctypes.c_int, u8p, i32p]
     lib.ggrs_qs_new.restype = ctypes.c_void_p
     lib.ggrs_qs_free.argtypes = [ctypes.c_void_p]
+    lib.ggrs_qs_free.restype = None
     lib.ggrs_qs_last_confirmed.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.ggrs_qs_last_confirmed.restype = ctypes.c_int32
     lib.ggrs_qs_delay.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -75,10 +76,14 @@ def _load():
     lib.ggrs_qs_confirmed_span.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_int32, ctypes.c_int32,
         u8p, u8p]
+    lib.ggrs_qs_confirmed_span.restype = None
     lib.ggrs_qs_discard_before.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.ggrs_qs_discard_before.restype = None
     lib.ggrs_qs_reset.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                   ctypes.c_int32, u8p]
+    lib.ggrs_qs_reset.restype = None
     lib.ggrs_qs_last_input.argtypes = [ctypes.c_void_p, ctypes.c_int, u8p]
+    lib.ggrs_qs_last_input.restype = None
     lib.ggrs_qs_min_confirmed.argtypes = [ctypes.c_void_p, u8p]
     lib.ggrs_qs_min_confirmed.restype = ctypes.c_int32
     lib.ggrs_qs_gather.argtypes = [
@@ -87,16 +92,21 @@ def _load():
     lib.ggrs_rt_new.argtypes = [ctypes.c_int, ctypes.c_int]
     lib.ggrs_rt_new.restype = ctypes.c_void_p
     lib.ggrs_rt_free.argtypes = [ctypes.c_void_p]
+    lib.ggrs_rt_free.restype = None
     lib.ggrs_rt_record_used.argtypes = [
         ctypes.c_void_p, ctypes.c_int32, u8p, i32p]
+    lib.ggrs_rt_record_used.restype = None
     lib.ggrs_rt_note_confirmed.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_int32, u8p]
+    lib.ggrs_rt_note_confirmed.restype = None
     lib.ggrs_rt_first_incorrect.argtypes = [ctypes.c_void_p]
     lib.ggrs_rt_first_incorrect.restype = ctypes.c_int32
     lib.ggrs_rt_clear_first_incorrect.argtypes = [ctypes.c_void_p]
+    lib.ggrs_rt_clear_first_incorrect.restype = None
     lib.ggrs_rt_get_used.argtypes = [ctypes.c_void_p, ctypes.c_int32, u8p, i32p]
     lib.ggrs_rt_get_used.restype = ctypes.c_int
     lib.ggrs_rt_discard_before.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.ggrs_rt_discard_before.restype = None
     _lib = lib
     return lib
 

@@ -25,7 +25,13 @@ MAGIC = 0x47  # 'G'
 # compare per 16 confirmed frames). Version mismatch = datagram dropped, but
 # counted (see version_mismatch) so a skewed peer surfaces as an event
 # instead of an indefinite sync stall.
-VERSION = 2
+# v3: resource-checksum semantics changed (position-keyed parallel hash,
+# state.py:_resources_checksum) — checksum VALUES differ across builds for
+# bit-identical worlds, so mixed-version peers must fail the handshake with
+# VERSION_MISMATCH instead of firing a false DESYNC_DETECTED on the first
+# compared resource-bearing frame. Checksum semantics are part of the wire
+# contract this version gates.
+VERSION = 3
 
 T_SYNC_REQUEST = 1
 T_SYNC_REPLY = 2

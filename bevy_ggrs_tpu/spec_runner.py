@@ -216,6 +216,21 @@ def _code_fp(code, depth: int):
     )
 
 
+def _all_co_names(code) -> set:
+    """Global names read anywhere in a code object, including nested
+    functions/lambdas/comprehensions — a global referenced only inside a
+    nested code object lives in THAT object's co_names, and resolving only
+    the top level would let a runtime rebind of such a constant produce an
+    identical fingerprint (round-4 advice #4)."""
+    import types
+
+    names = set(code.co_names)
+    for const in code.co_consts:
+        if isinstance(const, types.CodeType):
+            names |= _all_co_names(const)
+    return names
+
+
 def _fn_fp(fn, depth: int = 0):
     """Fingerprint a system function: bytecode+consts hash, closure cells,
     default args, and the module globals its code names — everything that
@@ -256,7 +271,7 @@ def _fn_fp(fn, depth: int = 0):
     globals_fp = []
     g = getattr(fn, "__globals__", {})
     own_module = getattr(fn, "__module__", "")
-    for name in code.co_names:
+    for name in sorted(_all_co_names(code)):
         if name not in g:
             continue  # builtin or attribute name
         v = g[name]
@@ -327,6 +342,15 @@ def _attestation_key(runner: "SpeculativeRollbackRunner"):
             runner.num_branches,
             runner.spec_frames,
             runner.num_players,
+            # The serial-burst executable is padded to executor.max_frames
+            # and the ring shapes follow max_prediction — two runners
+            # differing only in max_prediction run DIFFERENT compiled
+            # serial programs and attest a different frame count
+            # F=min(spec_frames, max_frames); they must not share a verdict
+            # (round-4 advice #2).
+            runner.max_prediction,
+            runner.executor.max_frames,
+            runner.ring.depth,
             tuple(np.asarray(v).tobytes() for v in runner._branch_values),
             mesh_fp,
         )
