@@ -273,10 +273,14 @@ class GGRSStage:
         except PredictionThreshold:
             self.frames_skipped += 1  # `ggrs_stage.rs:251-253`: skip + log
             return
-        self.runner.handle_requests(requests, session)
-        speculate = getattr(self.runner, "speculate", None)
-        if speculate is not None:
-            speculate(session.confirmed_frame(), session)
+        # The speculative runner executes the whole tick (burst + branch
+        # commit + next rollout) as ONE fused device dispatch; the plain
+        # runner just executes the burst.
+        tick = getattr(self.runner, "tick", None)
+        if tick is not None:
+            tick(requests, session.confirmed_frame(), session)
+        else:
+            self.runner.handle_requests(requests, session)
 
     def _step_spectator(self, app: RollbackApp) -> None:
         session: SpectatorSession = app.session
