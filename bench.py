@@ -653,10 +653,27 @@ def _live_session_case(model: str, speculate: bool, transport: str) -> dict:
     cfg = _live_model_zoo()[model]
     if model == "boids" and jax.default_backend() == "cpu":
         # The MXU Pallas kernel runs interpreted (100x) on CPU; the
-        # _cpuhost pair exercises the same model through the XLA kernel.
+        # _cpuhost pair exercises the same model through the XLA kernel,
+        # sized for a 1-core host (128 boids, 4 branches) so the rollout
+        # can actually hide in the 16.7 ms frame budget. Both sides of
+        # the spec-on/off pair use this identical config.
         from bevy_ggrs_tpu.models import boids
 
-        cfg = dict(cfg, schedule=lambda: boids.make_schedule(kernel="xla"))
+        cfg = dict(
+            cfg,
+            branches=4,
+            schedule=lambda: boids.make_schedule(kernel="xla"),
+            world=lambda p: boids.make_world(128, p).commit(),
+        )
+    if model == "neural_bots" and jax.default_backend() == "cpu":
+        # Same 1-core sizing rationale as boids: the B-branch rollout must
+        # hide inside the 16.7 ms frame budget on the host it runs on.
+        from bevy_ggrs_tpu.models import neural_bots
+
+        cfg = dict(
+            cfg, branches=16,
+            world=lambda p: neural_bots.make_world(128, p).commit(),
+        )
     players = cfg["players"]
     # GGRS_LIVE_FRAMES overrides the per-model tick count (CI smokes the
     # live harness with ~120 frames; the real matrix uses the defaults).
@@ -932,7 +949,7 @@ _LIVE_CONFIGS["live_box_game_udp_spec_on"] = ("box_game", True, "udp")
 # only ever shown against a different backend). (boids' MXU kernel runs
 # interpreted on CPU; its cpuhost pair swaps in the XLA kernel — see
 # _live_session_case's cpu override.)
-for _m in ("box_game", "projectiles", "boids"):
+for _m in ("box_game", "projectiles", "boids", "neural_bots"):
     for _s in (True, False):
         _LIVE_CONFIGS[
             f"live_{_m}_loopback_spec_{'on' if _s else 'off'}_cpuhost"

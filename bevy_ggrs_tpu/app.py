@@ -442,4 +442,23 @@ class GGRSPlugin:
                     data=dataclasses.asdict(attestation),
                 )
             )
+        elif (
+            attestation is not None
+            and attestation.scanned_proxy_divergence
+            and not attestation.exhaustive
+        ):
+            # Attestation passed, but the scanned all-branch layer
+            # self-disqualified: effective full-coverage assurance rests
+            # on the real-executable replays only. Surface it (round-4
+            # verdict weak #7) so operators can opt into
+            # GGRS_ATTEST_EXHAUSTIVE=1 instead of shipping ~8-branch
+            # effective coverage unknowingly.
+            from bevy_ggrs_tpu.session.common import EventKind, SessionEvent
+
+            app.events.append(
+                SessionEvent(
+                    EventKind.ATTESTATION_DEGRADED,
+                    data=dataclasses.asdict(attestation),
+                )
+            )
         return app

@@ -19,16 +19,24 @@ Design points:
 - Player inputs steer per-player "leader" targets the bots pursue, so the
   full session machinery (prediction, rollback, checksums, speculation)
   applies unchanged with the same u8 bitmask inputs as box_game.
-- Determinism: matmuls in float32 with fixed shapes — bit-reproducible per
-  platform+executable like every other model here (docs/determinism.md).
-  NOT attested speculation-safe everywhere: vmapping the policy over
-  speculative branches makes the matmuls batched, and backends may
-  accumulate batched matmuls in a different order (the CPU backend does —
-  caught by ``spec_runner.attest_speculation_safety``, which auto-disables
-  speculation for this model there; the serial rollback path is unaffected).
+- Determinism — EXECUTABLE-STABLE BY CONSTRUCTION (round-4 verdict item
+  3): every reduction over a variable-length axis is integer. The policy
+  runs as an int8-quantized MLP (int8 × int8 → int32 ``dot`` — the TPU
+  MXU's native integer path), and the flock centroid accumulates in Q8.8
+  fixed point. Integer accumulation is exactly associative, so the
+  vmapped speculative rollout, the serial burst, and any scanned/meshed
+  recompilation produce bit-identical states REGARDLESS of how XLA orders
+  the accumulation — the float version of this model attested
+  speculation-UNSAFE on both backends (a batched-matmul rounding
+  divergence on branch #26 that only full-coverage attestation caught).
+  Float ops remain only where they are elementwise (tanh, scaling) or
+  fixed-arity (2-element norms), which are order-free. The weights are
+  quantized ONCE at registry creation: the int8 tensors ARE the game
+  content that ships, rolls back, and hashes — not a lossy runtime cast.
 
 Observation (8 features): bot velocity (2), vector to own target (2),
-distance to target (1), vector to flock centroid (2), bias (1).
+distance to target (1), vector to flock centroid (2), bias (1) — each
+normalized by a fixed per-feature bound, then quantized to int8.
 Action (4 logits): accelerate +x/-x/+y/-y, applied as tanh-squashed accel.
 """
 
@@ -68,16 +76,35 @@ ACCEL_SCALE = np.float32(0.02)
 MAX_SPEED = np.float32(0.15)
 WORLD_HALF = np.float32(6.0)
 
+# Fixed-point scales. QA quantizes activations/observations to int8, QW
+# the weights; POS_Q is the Q8.8 centroid accumulator scale. All are part
+# of the game's content contract — changing them changes the simulation.
+QA = np.float32(127.0)
+QW = np.float32(64.0)
+POS_Q = np.float32(256.0)
+# Per-feature observation bounds (velocity 2, to_target 2, dist 1,
+# to_centroid 2, bias 1): obs/OBS_NORM lands in ~[-1, 1] before int8
+# quantization.
+OBS_NORM = np.array(
+    [0.15, 0.15, 12.0, 12.0, 17.0, 12.0, 12.0, 1.0], np.float32
+)
+
 
 def make_policy_params(seed: int = 0, hidden: int = HIDDEN):
-    """Deterministic MLP weights (fixed seed = part of the game's content)."""
+    """Deterministic int8-quantized MLP weights (fixed seed = part of the
+    game's content). The float draws are quantized HERE, once — the int8
+    tensors are the canonical weights that roll back and hash."""
     rng = np.random.RandomState(seed)
     scale1 = 1.0 / math.sqrt(OBS_DIM)
     scale2 = 1.0 / math.sqrt(hidden)
+
+    def q(w):
+        return np.clip(np.round(w * QW), -127, 127).astype(np.int8)
+
     return {
-        "w1": (rng.randn(OBS_DIM, hidden) * scale1).astype(np.float32),
+        "w1": q(rng.randn(OBS_DIM, hidden) * scale1),
         "b1": np.zeros((hidden,), np.float32),
-        "w2": (rng.randn(hidden, ACT_DIM) * scale2).astype(np.float32),
+        "w2": q(rng.randn(hidden, ACT_DIM) * scale2),
         "b2": np.zeros((ACT_DIM,), np.float32),
     }
 
@@ -151,33 +178,54 @@ def steer_targets_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
 
 
 def policy_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
-    """Batched MLP inference -> acceleration, then clamped integration.
+    """Quantized MLP inference -> acceleration, then clamped integration.
 
-    The two matmuls ([cap, OBS] @ [OBS, H] and [cap, H] @ [H, 4]) are the
-    MXU work; everything else fuses around them.
+    The two int8 dots ([cap, OBS] @ [OBS, H] and [cap, H] @ [H, 4],
+    ``preferred_element_type=int32``) are the MXU work — its native
+    integer path — and, being exact integer accumulations, they are
+    bitwise-stable under ANY batching/layout XLA picks (the speculation
+    executable-stability contract, docs/determinism.md). The only other
+    variable-length reduction, the flock centroid, accumulates in Q8.8
+    int32 for the same reason. Everything else is elementwise float or a
+    fixed 2-element norm, which are order-free.
     """
     del inputs
     pos = state.components["position"]  # [cap, 2]
     vel = state.components["velocity"]
     team = jnp.clip(state.components["team"], 0, MAX_PLAYERS - 1)
     alive = state.alive
-    active = (alive & state.present["position"]).astype(jnp.float32)[:, None]
+    active_i = (alive & state.present["position"]).astype(jnp.int32)
+    active = active_i.astype(jnp.float32)[:, None]
 
     targets = state.resources["targets"][team]  # [cap, 2]
     to_target = targets - pos
     dist = jnp.sqrt(jnp.sum(to_target * to_target, axis=1, keepdims=True) + 1e-8)
-    n_alive = jnp.maximum(jnp.sum(active), 1.0)
-    centroid = jnp.sum(pos * active, axis=0, keepdims=True) / n_alive
+    n_alive_i = jnp.maximum(jnp.sum(active_i), 1)
+    pos_q = jnp.round(pos * POS_Q).astype(jnp.int32)  # Q8.8 fixed point
+    centroid = (
+        jnp.sum(pos_q * active_i[:, None], axis=0, keepdims=True)
+        .astype(jnp.float32)
+        / (POS_Q * n_alive_i.astype(jnp.float32))
+    )
     to_centroid = centroid - pos
 
     obs = jnp.concatenate(
         [vel, to_target, dist, to_centroid, jnp.ones_like(dist)], axis=1
     )  # [cap, 8]
+    obs_q = jnp.clip(
+        jnp.round(obs / OBS_NORM * QA), -127, 127
+    ).astype(jnp.int8)
 
     p = state.resources["policy"]
-    hidden = jnp.tanh(obs @ p["w1"] + p["b1"])  # MXU
-    logits = hidden @ p["w2"] + p["b2"]  # MXU
-    act = jnp.tanh(logits)
+    acc1 = jnp.matmul(
+        obs_q, p["w1"], preferred_element_type=jnp.int32
+    )  # MXU int8
+    hidden = jnp.tanh(acc1.astype(jnp.float32) / (QA * QW) + p["b1"])
+    hidden_q = jnp.round(hidden * QA).astype(jnp.int8)
+    acc2 = jnp.matmul(
+        hidden_q, p["w2"], preferred_element_type=jnp.int32
+    )  # MXU int8
+    act = jnp.tanh(acc2.astype(jnp.float32) / (QA * QW) + p["b2"])
     accel = jnp.stack([act[:, 0] - act[:, 1], act[:, 2] - act[:, 3]], axis=1)
 
     new_vel = vel + accel * ACCEL_SCALE

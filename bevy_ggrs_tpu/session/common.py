@@ -76,6 +76,14 @@ class EventKind(enum.Enum):
     # vmapped rollout and serial burst disagreed bitwise for this model, so
     # speculative recovery was auto-disabled (serial path stays correct).
     SPECULATION_DISABLED = "speculation_disabled"  # data: attestation detail
+    # Extension: attestation PASSED but the scanned all-branch proxy layer
+    # self-disqualified (it disagreed with the rollout while the real
+    # serial executable agreed) — effective full-coverage assurance then
+    # rests on the real-executable layer plus the adjudicated branches,
+    # which is weaker than the headline "scanned_branches" suggests.
+    # data: attestation detail incl. effective coverage; run with
+    # GGRS_ATTEST_EXHAUSTIVE=1 to restore full real-executable coverage.
+    ATTESTATION_DEGRADED = "attestation_degraded"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -398,3 +398,74 @@ def _projectiles_serial():
         num_players=2,
         input_spec=pj.INPUT_SPEC,
     )
+
+
+class TestExhaustiveAndDegradation:
+    def test_exhaustive_mode_real_checks_every_branch(self, monkeypatch):
+        """GGRS_ATTEST_EXHAUSTIVE=1: every branch of BOTH tensors replays
+        through the real serial executable (2B total), independent of the
+        scanned proxy's verdict."""
+        monkeypatch.setenv("GGRS_ATTEST_EXHAUSTIVE", "1")
+        runner = make_spec_runner(box_game, box_game.make_world(2))
+        report = attest_speculation_safety(runner)
+        assert report.ok and report.exhaustive
+        assert report.branches_checked == runner.num_branches
+        assert report.real_checked == 2 * runner.num_branches
+
+    def test_exhaustive_verdict_not_served_from_standard_cache(
+        self, monkeypatch
+    ):
+        """The memo key includes the exhaustive flag: a standard cached
+        verdict must not satisfy an exhaustive request."""
+        import bevy_ggrs_tpu.spec_runner as sr
+
+        monkeypatch.delenv("GGRS_ATTEST_EXHAUSTIVE", raising=False)
+        a = make_spec_runner(box_game, box_game.make_world(2))
+        ka = sr._attestation_key(a)
+        monkeypatch.setenv("GGRS_ATTEST_EXHAUSTIVE", "1")
+        kb = sr._attestation_key(a)
+        assert ka is not None and kb is not None and ka != kb
+
+    def test_proxy_divergence_surfaces_degradation_event(self, monkeypatch):
+        """When attestation passes but the scanned proxy self-disqualifies,
+        the app must surface ATTESTATION_DEGRADED with the report attached
+        (round-4 verdict weak #7) — forced here by faking the report."""
+        import bevy_ggrs_tpu.spec_runner as sr
+        from bevy_ggrs_tpu.app import GGRSPlugin
+
+        degraded = sr.AttestationReport(
+            ok=True, branches_checked=8, frames=4, scanned_branches=8,
+            structured_checked=True, scanned_proxy_divergence=True,
+            real_checked=10,
+        )
+        monkeypatch.setattr(
+            sr, "attest_speculation_safety", lambda r, **kw: degraded
+        )
+        monkeypatch.setenv("GGRS_ATTEST_CACHE", "0")
+        def setup(world, app):
+            box_game.spawn_players(
+                world, 2, next_id=app.rollback_id_provider.next_id
+            )
+
+        plugin = (
+            GGRSPlugin(box_game.INPUT_SPEC)
+            .with_num_players(2)
+            .with_rollback_schedule(box_game.make_schedule())
+            .with_input_system(lambda h, app: np.uint8(0))
+            .with_setup_system(setup)
+            .with_speculation(8)
+        )
+        plugin.registry = box_game.make_registry()
+        app = plugin.build()
+        kinds = [e.kind for e in app.events]
+        assert EventKind.ATTESTATION_DEGRADED in kinds
+        assert EventKind.SPECULATION_DISABLED not in kinds
+        ev = next(
+            e for e in app.events
+            if e.kind == EventKind.ATTESTATION_DEGRADED
+        )
+        assert ev.data["scanned_proxy_divergence"] is True
+        assert ev.data["real_checked"] == 10
+        # Speculation itself stays ENABLED: degraded coverage is a
+        # warning, not a failure.
+        assert app.stage.runner.speculation_enabled

@@ -64,6 +64,8 @@ def test_two_peer_lossy_soak_1500_frames():
         for ep in s._endpoints.values():
             for spans in ep._pending_output.values():
                 assert len(spans) < 200, "unacked output grew unbounded"
-    assert len(peers[0][1]._input_log) < 32, "spec input log grew unbounded"
+    # Ring-depth window + the 64 frames of history the input predictor
+    # (recency ranking / periodic extrapolation) is allowed to keep.
+    assert len(peers[0][1]._input_log) < 100, "spec input log grew unbounded"
     # Speculation engaged over the run.
     assert peers[0][1].spec_hits + peers[0][1].spec_partial_hits > 0

@@ -236,33 +236,83 @@ def test_structured_base_forward_fills_known_changes():
     assert (bits[:, 0, 0] == 9).all()
 
 
+def _candidates_loop_oracle(spec, last):
+    """Independent straight-Python candidate ranking: recent distinct
+    as-used values (newest first), then press/release toggles of
+    recently-changed bits, then the declared universe."""
+    nP = spec.num_players
+    shape = spec.input_spec.shape
+    n_field = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    dtype = spec.input_spec.zeros_np(1).dtype
+    lastf = np.asarray(last).reshape(nP, n_field)
+    frames = sorted(spec._input_log)[-32:]
+    rows = {}
+    for h in range(nP):
+        for k in range(n_field):
+            seq = [
+                int(np.asarray(spec._input_log[f]).reshape(nP, n_field)[h, k])
+                for f in frames
+            ]
+            recent = []
+            for v in reversed(seq):
+                if v not in recent:
+                    recent.append(v)
+            toggles = []
+            if np.issubdtype(dtype, np.integer):
+                changed = 0
+                for a, b in zip(seq, seq[1:]):
+                    changed |= a ^ b
+                top = max((int(v) for v in spec._branch_values), default=0)
+                all_bits, bit = [], 1
+                while bit <= max(changed, top):
+                    all_bits.append(bit)
+                    bit <<= 1
+                for b in [x for x in all_bits if changed & x] + [
+                    x for x in all_bits if not (changed & x)
+                ]:
+                    toggles.append(int(lastf[h, k]) ^ b)
+            allowed = {int(v) for v in spec._branch_values}
+            row = []
+            for v in recent + toggles + [int(v) for v in spec._branch_values]:
+                if v not in row and v in allowed:
+                    row.append(v)
+            rows[h, k] = row
+    return rows
+
+
 def _structured_bits_loop_oracle(spec, last, known, known_mask):
-    """The round-3 loop implementation, kept verbatim as the
-    enumeration-order oracle for the vectorized builder (the live tree
-    must keep the exact branch numbering: earliest change frame first,
-    then player, then field, then value, skipping pinned slots and
-    values equal to the base prediction)."""
+    """Straight-Python enumeration oracle for the vectorized builder:
+    (candidate-rank, frame, player, field)-major over the history-ranked
+    candidate rows, skipping pinned slots, rank padding, and values equal
+    to the base prediction."""
     from bevy_ggrs_tpu.spec_runner import _forward_fill
 
     F, P_, B = spec.spec_frames, spec.num_players, spec.num_branches
     shape = spec.input_spec.shape
     base = _forward_fill(last, known, known_mask)
     out = np.broadcast_to(base, (B, F, P_) + shape).copy()
+    rows = _candidates_loop_oracle(spec, last)
+    max_r = max(len(r) for r in rows.values())
     b = 1
     frames_idx = np.arange(F)
-    for t in range(F):
-        for h in range(P_):
-            if known_mask[t, h]:
-                continue
-            suffix = (frames_idx >= t) & ~known_mask[:, h]
-            for field in np.ndindex(shape):
-                idx = (suffix, h) + field
-                for v in spec._branch_values:
+    for r in range(max_r):
+        for t in range(F):
+            for h in range(P_):
+                if known_mask[t, h]:
+                    continue
+                suffix = (frames_idx >= t) & ~known_mask[:, h]
+                for k, field in enumerate(np.ndindex(shape)) if shape else [
+                    (0, ())
+                ]:
+                    row = rows[h, k]
+                    if r >= len(row):
+                        continue
+                    v = row[r]
                     if b >= B:
                         return out
                     if v == base[(t, h) + field]:
                         continue
-                    out[(b,) + idx] = v
+                    out[(b,) + (suffix, h) + field] = v
                     b += 1
     return out
 
@@ -271,7 +321,8 @@ def test_structured_bits_vectorized_matches_loop_oracle():
     """The vectorized tree builder (round-3 verdict weak #5: the Python
     O(B·F) loop cost milliseconds per tick at the stress shape) must
     reproduce the loop enumeration bit-for-bit, including at the stress
-    shape P=8, F=12, B=1024."""
+    shape P=8, F=12, B=1024 — with and without input history driving the
+    candidate ranking."""
     rng = np.random.RandomState(5)
     cases = [(4, 4, P, make_runners(None, 4, 4)[1]), (96, 4, P, None)]
     for B, F, nP, spec in cases + [(1024, 12, 8, None)]:
@@ -289,6 +340,13 @@ def test_structured_bits_vectorized_matches_loop_oracle():
         got = spec._structured_bits(last, known, mask)
         want = _structured_bits_loop_oracle(spec, last, known, mask)
         assert np.array_equal(got, want), (B, F, nP)
+        # With as-used history: recency + toggle ranking kicks in.
+        for f in range(6):
+            spec._input_log[f] = rng.randint(0, 16, (nP,)).astype(np.uint8)
+        got = spec._structured_bits(last, known, mask)
+        want = _structured_bits_loop_oracle(spec, last, known, mask)
+        assert np.array_equal(got, want), ("hist", B, F, nP)
+        spec._input_log.clear()
     # Degenerate: everything pinned -> every branch is the base prediction.
     spec = make_runners(None, 4, 4)[1]
     last = np.array([1, 2], np.uint8)
@@ -296,6 +354,43 @@ def test_structured_bits_vectorized_matches_loop_oracle():
     mask = np.ones((4, P), bool)
     bits = spec._structured_bits(last, known, mask)
     assert (bits == bits[0]).all()
+
+
+def test_candidate_ranking_prioritizes_recent_and_toggles():
+    """Projectiles' live failure mode (round-4 verdict item 2): a player
+    alternating UP <-> UP|FIRE in a 32-value universe. The candidate row
+    must lead with the recent working set, so the FIRE transition is
+    covered at EVERY frame by a small tree."""
+    from bevy_ggrs_tpu.models import projectiles
+
+    spec = SpeculativeRollbackRunner(
+        box_game.make_schedule(), box_game.make_world(2).commit(),
+        max_prediction=8, num_players=2,
+        input_spec=projectiles.INPUT_SPEC, num_branches=64,
+    )
+    UP, FIRE = projectiles.INPUT_UP, projectiles.INPUT_FIRE
+    # Irregular (APERIODIC) fire tapping: the periodic extrapolator must
+    # not trigger, leaving coverage to the transition-ranked tree.
+    pattern = [0, 0, 0, 1, 1, 0, 0, 0, 0, 1, 1, 1]
+    for f, fire in enumerate(pattern):
+        bits = np.array([UP | (FIRE if fire else 0), 0], np.uint8)
+        spec._input_log[f] = bits
+    last = np.array([UP, 0], np.uint8)
+    C, valid = spec._candidate_values(last)
+    row0 = [int(v) for v in C[0, 0][valid[0, 0]]]
+    # Player 0's top candidates are its two recent values; UP|FIRE (the
+    # transition from last=UP) ranks in the top two.
+    assert (UP | FIRE) in row0[:2]
+    # The tree therefore covers the FIRE press at every unknown frame:
+    known = np.zeros((8, 2), np.uint8)
+    mask = np.zeros((8, 2), bool)
+    tree = spec._structured_bits(last, known, mask)
+    for t in range(8):
+        wanted = np.broadcast_to(last, (8, 2)).copy()
+        wanted[t:, 0] = UP | FIRE
+        assert any(
+            np.array_equal(tree[b], wanted) for b in range(64)
+        ), f"FIRE press at frame {t} not enumerated"
 
 
 def test_confirmed_span_bulk_query_matches_getter():
@@ -529,3 +624,58 @@ def test_random_sampler_path_never_dedups():
     spec.speculate(1)
     assert spec._result is not first  # fresh draw, no skip
     assert spec.spec_dispatches_skipped == 0
+
+
+def test_periodic_extrapolation_covers_multi_player_cycles():
+    """Two remote players cycling keys every 3 frames (the projectiles
+    live workload): repeat-last mispredicts every boundary and a span
+    contains boundaries from BOTH players — unreachable for single-change
+    branches. The periodic extrapolation base must predict both players'
+    continuations exactly, so branch 1 matches the true future."""
+    spec = SpeculativeRollbackRunner(
+        box_game.make_schedule(), box_game.make_world(2).commit(),
+        max_prediction=8, num_players=2,
+        input_spec=box_game.INPUT_SPEC, num_branches=16, spec_frames=8,
+    )
+    keys = [1, 2, 4, 0]
+
+    def scripted(h, f):
+        return keys[(f // 3 + h) % 4]
+
+    for f in range(40):
+        spec._input_log[f] = np.array(
+            [scripted(0, f), scripted(1, f)], np.uint8
+        )
+    anchor = 40
+    last = spec._input_log[anchor - 1]
+    known = np.zeros((8, 2), np.uint8)
+    mask = np.zeros((8, 2), bool)
+    tree = spec._structured_bits(last, known, mask, anchor)
+    truth = np.array(
+        [[scripted(h, anchor + t) for h in range(2)] for t in range(8)],
+        np.uint8,
+    )
+    # Branch 0 stays the session's forward-fill prediction...
+    assert np.array_equal(tree[0], np.broadcast_to(last, (8, 2)))
+    # ...and branch 1 IS the true periodic future for both players.
+    assert np.array_equal(tree[1], truth), (tree[1], truth)
+
+
+def test_extrapolation_falls_back_without_periodicity():
+    """Aperiodic history must leave the tree identical to the plain
+    forward-fill single-change enumeration (no wasted branch 1)."""
+    rng = np.random.RandomState(9)
+    spec = SpeculativeRollbackRunner(
+        box_game.make_schedule(), box_game.make_world(2).commit(),
+        max_prediction=8, num_players=2,
+        input_spec=box_game.INPUT_SPEC, num_branches=16, spec_frames=8,
+    )
+    for f in range(40):
+        spec._input_log[f] = rng.randint(0, 16, (2,)).astype(np.uint8)
+    last = spec._input_log[39]
+    known = np.zeros((8, 2), np.uint8)
+    mask = np.zeros((8, 2), bool)
+    tree = spec._structured_bits(last, known, mask, 40)
+    base = np.broadcast_to(last, (8, 2))
+    assert np.array_equal(tree[0], base)
+    assert not np.array_equal(tree[1], tree[0])  # a real change branch
