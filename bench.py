@@ -39,6 +39,20 @@ import numpy as np
 BUDGET_MS = 16.0  # one 60 Hz render frame
 HEADLINE = "box_game_rollback_8f_x_256b_latency"
 
+# Persistent XLA compilation cache, shared with the test suite: every
+# matrix config runs in its own subprocess (process isolation, see above)
+# and would otherwise recompile identical programs from cold — a warm
+# cache cuts per-config startup severalfold. Keyed by HLO hash, so stale
+# entries are impossible. Must go through jax.config.update: this image's
+# sitecustomize imports jax before us, so env-var forms were already read.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                   "/tmp/bevy_ggrs_tpu_jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
 
 def _ensure_backend() -> str:
     """Use the default (TPU) backend when it comes up; fall back to CPU so a
@@ -887,6 +901,12 @@ def _live_session_case(model: str, speculate: bool, transport: str) -> dict:
         for me, (session, runner) in enumerate(peers):
             t0 = time.perf_counter()
             n_sync0 = len(sync_series)
+            # Flush deferred checksum reports BEFORE the poll's send gate
+            # (a corrected re-report must supersede its stale predecessor
+            # in the local map before the session may transmit it).
+            flush = getattr(runner, "flush_reports", None)
+            if flush is not None:
+                flush(session)
             session.poll_remote_clients()
             for ev in session.events():  # drain; the run is also a soak
                 if ev.kind.name == "DESYNC_DETECTED":
@@ -1047,6 +1067,12 @@ def _live_8p_spectator_case(speculate: bool) -> dict:
         for me, (session, runner) in enumerate(peers):
             t0 = time.perf_counter()
             n_sync0 = len(sync_series)
+            # Flush deferred checksum reports BEFORE the poll's send gate
+            # (a corrected re-report must supersede its stale predecessor
+            # in the local map before the session may transmit it).
+            flush = getattr(runner, "flush_reports", None)
+            if flush is not None:
+                flush(session)
             session.poll_remote_clients()
             for ev in session.events():
                 if ev.kind.name == "DESYNC_DETECTED":

@@ -233,8 +233,15 @@ class GGRSStage:
             fps_delta *= 1.1  # catch-up stretch (`ggrs_stage.rs:107-109`)
 
         # Pump the network every render frame, unconditionally
-        # (`ggrs_stage.rs:113-119`).
+        # (`ggrs_stage.rs:113-119`). Deferred checksum reports flush
+        # FIRST: the session's send gate runs inside poll, and a frame's
+        # corrected re-report must land in the local map before the
+        # session may transmit it (a stale predicted-state checksum sent
+        # after its rollback would fire a false DESYNC_DETECTED).
         if app.session_type in (SessionType.P2P, SessionType.SPECTATOR):
+            flush = getattr(self.runner, "flush_reports", None)
+            if flush is not None:
+                flush(app.session)
             with self.metrics.timer("poll"):
                 app.session.poll_remote_clients(now)
             app.events.extend(app.session.events())

@@ -703,6 +703,12 @@ class SpeculativeRollbackRunner(RollbackRunner):
         # rollout (the anchor state is ring-fixed once the frontier lags).
         self._spec_sig = None
         self._input_log = {}  # as-used inputs, frame -> bits (host)
+        # Deferred checksum reports: (device_cs_array, [(row, frame)]).
+        # The fused tick never blocks on its own outputs — wanted
+        # checksums are read at the START of the next tick, by which time
+        # the producing program has completed during the frame's idle
+        # time (telemetry must not sit on the tick critical path).
+        self._pending_reports = []
         self.spec_dispatches_skipped = 0
         self.spec_hits = 0
         self.spec_partial_hits = 0
@@ -719,6 +725,9 @@ class SpeculativeRollbackRunner(RollbackRunner):
         self._result = None
         self._spec_sig = None
         self._input_log.clear()
+        # Reports computed from the pre-restore world must not surface
+        # into the post-restore session.
+        self._pending_reports.clear()
 
     def warmup(self) -> None:
         """Compile the serial executor AND the fused tick program (absorb +
@@ -797,8 +806,15 @@ class SpeculativeRollbackRunner(RollbackRunner):
         inlines the same absorb/burst/rollout bodies, and every
         non-canonical shape (multi-segment request lists, non-standard
         bursts, ticks whose speculation is skipped or disabled) falls back
-        to exactly that legacy pair."""
+        to exactly that legacy pair.
+
+        Checksum reports from the fused paths are DEFERRED one tick:
+        wanted checksums queue as device arrays and are read at the start
+        of the next tick, by which time the producing program has
+        completed in the frame's idle time — telemetry never blocks the
+        tick critical path (the fallback paths keep synchronous reads)."""
         self.ticks_total += 1
+        self.flush_reports(session)
         if not self.speculation_enabled:
             self._result = None
             self.handle_requests(requests, session)
@@ -987,30 +1003,42 @@ class SpeculativeRollbackRunner(RollbackRunner):
             else:
                 self.rollback_frames_total += n_steps
                 self.metrics.count("rollback_frames", n_steps)
-        # Checksum reporting: sync only the frames the session wants.
+        # Checksum reporting: queue only the frames the session wants;
+        # the device arrays are read next tick (see docstring).
         if session is not None and self.report_checksums:
             wants = getattr(session, "wants_checksum", None)
             report_a = [
-                t for t in range(n_commit)
+                (t, load_frame + t) for t in range(n_commit)
                 if wants is None or wants(load_frame + t)
             ]
             report_b = [
-                t for t in range(len(tail))
+                (t, burst_start + t) for t in range(len(tail))
                 if wants is None or wants(burst_start + t)
             ]
-            if report_a or report_b:
-                with self.metrics.timer("checksum_sync"):
-                    a_host = np.asarray(absorb_cs) if report_a else None
-                    b_host = np.asarray(burst_cs) if report_b else None
-                for t in report_a:
-                    session.report_checksum(
-                        load_frame + t, combine64(a_host[t])
-                    )
-                for t in report_b:
-                    session.report_checksum(
-                        burst_start + t, combine64(b_host[t])
-                    )
+            if report_a:
+                self._pending_reports.append((absorb_cs, report_a))
+            if report_b:
+                self._pending_reports.append((burst_cs, report_b))
         self._gc_log()
+
+    def flush_reports(self, session) -> None:
+        """Deliver deferred checksum reports (device reads happen here,
+        off the producing tick's critical path). Called automatically at
+        the start of every :meth:`tick`; call manually before tearing a
+        session down if the last tick's reports must not be dropped."""
+        if not self._pending_reports:
+            return
+        if session is None:
+            # Keep the queue: reports were generated against a real
+            # session (queueing is session-gated) and must not be lost to
+            # an interleaved session-less call.
+            return
+        pending, self._pending_reports = self._pending_reports, []
+        with self.metrics.timer("checksum_sync"):
+            host = [(np.asarray(arr), rows) for arr, rows in pending]
+        for cs_host, rows in host:
+            for t, frame in rows:
+                session.report_checksum(frame, combine64(cs_host[t]))
 
     def speculate(self, confirmed_frame: int, session=None) -> None:
         """Dispatch the next rollout from the confirmed frontier (frame
@@ -1112,16 +1140,11 @@ class SpeculativeRollbackRunner(RollbackRunner):
         if session is not None and self.report_checksums:
             wants = getattr(session, "wants_checksum", None)
             report = [
-                t for t in range(n_commit)
+                (t, load_frame + t) for t in range(n_commit)
                 if wants is None or wants(load_frame + t)
             ]
             if report:
-                with self.metrics.timer("checksum_sync"):
-                    cs_host = np.asarray(absorb_cs)
-                for t in report:
-                    session.report_checksum(
-                        load_frame + t, combine64(cs_host[t])
-                    )
+                self._pending_reports.append((absorb_cs, report))
 
     def _prev_buffers(self):
         """The previous rollout's branch-stacked (rings, states) — inputs

@@ -75,6 +75,7 @@ def run_tick(runner, script):
     log = ChecksumLog()
     for reqs, confirmed in script:
         runner.tick(reqs, confirmed, log)
+    runner.flush_reports(log)  # deliver the last tick's deferred reports
     return log
 
 
