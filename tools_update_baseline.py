@@ -1,0 +1,57 @@
+"""Regenerate BASELINE.md's status table from BENCH_DETAIL.json (run after
+a full `bench.py --all`). Prints the replacement '## Status' section to
+stdout; the builder pastes/commits it. Kept as a checked-in tool so the
+table provably derives from the artifact."""
+
+import json
+
+ROWS = [
+    ("HL", "box_game 8f × 256b (headline)", "box_game_rollback_8f_x_256b_latency"),
+    ("1", "box_game 2p, 4f × 1b", "box_game_2p_4f_x_1b"),
+    ("2", "box_game 2p, 8f × 64b", "box_game_2p_8f_x_64b"),
+    ("3", "box_game 4p, 8f × 256b", "box_game_4p_8f_x_256b"),
+    ("4", "1k boids, 8f × 128b (MXU kernel)", "boids_1k_8f_x_128b_mxu"),
+    ("5", "box_game 8p, 12f × 1024b", "box_game_8p_12f_x_1024b"),
+    ("+", "4k boids, 8f × 8b (triangle kernel)", "boids_4k_8f_x_8b_mxu"),
+    ("+", "8k boids, 8f × 2b (same pair count)", "boids_8k_8f_x_2b_mxu"),
+    ("+", "16k boids, 8f × 1b (2× pairs)", "boids_16k_8f_x_1b_mxu"),
+    ("+", "32k boids, 8f × 1b (8× pairs)", "boids_32k_8f_x_1b_mxu"),
+    ("+", "neural_bots 512 (H=32, int8), 8f × 64b", "neural_bots_512_8f_x_64b"),
+    ("+", "neural_bots H=256 (int8)", "neural_bots_512_h256_8f_x_64b"),
+    ("+", "neural_bots H=512 (int8)", "neural_bots_512_h512_8f_x_64b"),
+    ("+", "projectiles 4p/64cap, 8f × 64b", "projectiles_4p_64cap_8f_x_64b"),
+]
+
+
+def main() -> None:
+    d = json.load(open("BENCH_DETAIL.json"))
+    by = {c["metric"]: c for c in d["configs"]}
+    print("| # | Config | Measured (device) | vs budget | Met |")
+    print("|---|---|---|---|---|")
+    for num, label, key in ROWS:
+        e = by.get(key)
+        if e is None:
+            print(f"| {num} | {label} | MISSING | — | ❓ |")
+            continue
+        v, r = e["value"], e["vs_baseline"]
+        met = "✅" if r >= 1.0 else "❌"
+        print(f"| {num} | {label} | {v:.3f} ms | {r:.2f}× | {met} |")
+    print()
+    live = [c for c in d["configs"] if c["metric"].startswith("live_")]
+    print(f"Live entries: {len(live)}; desyncs total:",
+          sum(c.get("desync_events", 0) for c in live))
+    for pair_model in ("box_game", "projectiles", "boids", "neural_bots"):
+        on = by.get(f"live_{pair_model}_loopback_spec_on_cpuhost")
+        off = by.get(f"live_{pair_model}_loopback_spec_off_cpuhost")
+        if on and off:
+            print(
+                f"{pair_model}: ON recovery p50/p99 "
+                f"{on['recovery_p50_ms']}/{on['recovery_p99_ms']} vs OFF "
+                f"{off['recovery_p50_ms']}/{off['recovery_p99_ms']}  "
+                f"deadline {on['deadline_hit_rate']} vs "
+                f"{off['deadline_hit_rate']}  hits {on['spec_hits']}"
+            )
+
+
+if __name__ == "__main__":
+    main()
