@@ -169,3 +169,54 @@ def test_tick_fallback_paths_stay_correct():
     b.speculate(0, log_b)
     assert a.frame == b.frame == 1
     assert combine64(checksum(a.state)) == combine64(checksum(b.state))
+
+
+class WantingLog(ChecksumLog):
+    """Session stub that wants EVERY frame's checksum and records the
+    order reports arrive in — the shape of the deferred-report race."""
+
+    def __init__(self):
+        super().__init__()
+        self.order = []
+
+    def wants_checksum(self, frame):
+        return True
+
+    def report_checksum(self, frame, cs):
+        super().report_checksum(frame, cs)
+        self.order.append((frame, int(cs)))
+
+
+def test_deferred_reports_deliver_corrections_before_send_gate():
+    """Regression lock for the false-desync race: a frame saved on a
+    PREDICTED advance queues a (stale) checksum report; a rollback then
+    corrects and re-saves it, queueing the corrected report. The session's
+    send gate runs at the next poll — i.e. right after flush_reports() —
+    and MUST observe the corrected value (stale-then-corrected order, or
+    stale suppressed; never corrected-then-stale, never dropped). This
+    exact ordering bug fired a live DESYNC_DETECTED before the
+    flush-before-poll fix."""
+    spec = make_spec_runner()
+    serial_oracle = make_spec_runner()
+    log, oracle_log = WantingLog(), WantingLog()
+    script = _script_with_recovery([[1, 3], [1, 3]], [1, 3])
+    for reqs, confirmed in script:
+        spec.tick(reqs, confirmed, log)
+    # The send gate moment: pre-poll flush of the next tick.
+    spec.flush_reports(log)
+    # Oracle: the same script through the serial path, synchronous
+    # reporting (always final values).
+    for reqs, _ in script:
+        serial_oracle.handle_requests(reqs, oracle_log)
+    assert spec.spec_hits >= 1  # the rollback committed speculatively
+    for f in (3, 4, 5):
+        assert log.seen[f] == oracle_log.seen[f], f
+    # Real order property: once a frame's FINAL (corrected) value has
+    # been delivered, no later report may revert it — a
+    # corrected-then-stale reordering would leave the send gate a window
+    # where the map holds the stale value again.
+    for f in (3, 4, 5):
+        reports = [cs for frame, cs in log.order if frame == f]
+        final = oracle_log.seen[f]
+        first_final = reports.index(final)
+        assert all(cs == final for cs in reports[first_final:]), (f, reports)
