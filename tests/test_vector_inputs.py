@@ -294,3 +294,41 @@ def test_vector_speculation_live_session_equivalence_and_hits():
     assert spec_runner.rollbacks_total > 0
     # The structured single-field tree recovers real mispredictions live.
     assert spec_runner.spec_hits + spec_runner.spec_partial_hits > 0
+
+
+def test_periodic_extrapolation_per_field_vector_inputs():
+    """Per-(player, FIELD) period detection: field 0 cycles with period 4,
+    field 1 holds constant — the extrapolated base must continue field 0's
+    cycle exactly while leaving field 1 on repeat-last, independently per
+    player (players offset in phase)."""
+    from bevy_ggrs_tpu.models import box_game
+    from bevy_ggrs_tpu.spec_runner import SpeculativeRollbackRunner
+
+    spec = SpeculativeRollbackRunner(
+        box_game.make_schedule(), box_game.make_world(P).commit(),
+        max_prediction=8, num_players=P, input_spec=INPUT_SPEC,
+        num_branches=16, spec_frames=8,
+    )
+    cycle = [1, 2, 4, 8]
+
+    def field0(h, f):
+        return cycle[(f + h) % 4]
+
+    for f in range(40):
+        spec._input_log[f] = np.array(
+            [[field0(h, f), 7] for h in range(P)], np.uint8
+        )
+    anchor = 40
+    last = spec._input_log[anchor - 1]
+    known = np.zeros((8, P, 2), np.uint8)
+    mask = np.zeros((8, P), bool)
+    tree = spec._structured_bits(last, known, mask, anchor)
+    truth = np.array(
+        [[[field0(h, anchor + t), 7] for h in range(P)] for t in range(8)],
+        np.uint8,
+    )
+    # Branch 0 = forward-fill (field 0 stuck on its last value).
+    assert np.array_equal(tree[0], np.broadcast_to(last, (8, P, 2)))
+    assert not np.array_equal(tree[0], truth)
+    # Branch 1 = the true per-field periodic continuation.
+    assert np.array_equal(tree[1], truth), (tree[1], truth)
