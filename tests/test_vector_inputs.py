@@ -301,11 +301,8 @@ def test_periodic_extrapolation_per_field_vector_inputs():
     field 1 holds constant — the extrapolated base must continue field 0's
     cycle exactly while leaving field 1 on repeat-last, independently per
     player (players offset in phase)."""
-    from bevy_ggrs_tpu.models import box_game
-    from bevy_ggrs_tpu.spec_runner import SpeculativeRollbackRunner
-
     spec = SpeculativeRollbackRunner(
-        box_game.make_schedule(), box_game.make_world(P).commit(),
+        make_schedule(), make_world().commit(),
         max_prediction=8, num_players=P, input_spec=INPUT_SPEC,
         num_branches=16, spec_frames=8,
     )
