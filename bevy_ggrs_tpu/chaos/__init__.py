@@ -1,0 +1,33 @@
+"""Seeded, deterministic fault injection on the real transport path.
+
+``transport/loopback.py`` can already drop/delay datagrams, but only inside
+its own virtual network — the faults never exercise a real
+``NonBlockingSocket``. This package wraps ANY socket (UDP included) in a
+:class:`~bevy_ggrs_tpu.chaos.socket.ChaosSocket` driven by a replayable
+:class:`~bevy_ggrs_tpu.chaos.plan.ChaosPlan`: scheduled loss bursts,
+reordering, duplication, byte corruption, asymmetric partitions with heal
+windows, and peer kill/restart scripts. Every fault a soak run finds is
+reproducible from the plan's seed (docs/chaos.md).
+"""
+
+from bevy_ggrs_tpu.chaos.plan import (
+    ChaosPlan,
+    Corrupt,
+    Duplicate,
+    KillRestart,
+    LossBurst,
+    Partition,
+    Reorder,
+)
+from bevy_ggrs_tpu.chaos.socket import ChaosSocket
+
+__all__ = [
+    "ChaosPlan",
+    "ChaosSocket",
+    "Corrupt",
+    "Duplicate",
+    "KillRestart",
+    "LossBurst",
+    "Partition",
+    "Reorder",
+]

@@ -1,0 +1,219 @@
+"""ChaosPlan: a replayable fault schedule.
+
+A plan is a seed plus a list of time-windowed directives. The window times
+are in seconds on whatever clock drives the sockets (the loopback virtual
+clock in tests, wall time on real UDP), so the same plan file reproduces the
+same fault sequence on either transport. Probabilistic directives (loss,
+reorder, duplication, corruption) draw from per-socket RNGs derived from the
+plan seed — two runs of the same plan over the same traffic make identical
+drop/mangle decisions (docs/chaos.md, seed-replay workflow).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class LossBurst:
+    """Drop each datagram with probability ``rate`` while
+    ``start <= now < end``."""
+
+    start: float
+    end: float
+    rate: float
+
+
+@dataclasses.dataclass(frozen=True)
+class Reorder:
+    """Hold each datagram with probability ``rate`` for ``delay`` seconds
+    before forwarding, letting later sends overtake it."""
+
+    start: float
+    end: float
+    rate: float
+    delay: float = 0.05
+
+
+@dataclasses.dataclass(frozen=True)
+class Duplicate:
+    """Send each datagram twice with probability ``rate``."""
+
+    start: float
+    end: float
+    rate: float
+
+
+@dataclasses.dataclass(frozen=True)
+class Corrupt:
+    """Flip one random bit of each datagram with probability ``rate`` (the
+    receiver's ``decode`` must reject it — corrupted-packet hardening)."""
+
+    start: float
+    end: float
+    rate: float
+
+
+@dataclasses.dataclass(frozen=True)
+class Partition:
+    """Drop ALL traffic matching ``src -> dst`` while the window is open
+    (``end`` is the heal time). ``None`` is a wildcard, so one-sided
+    entries model asymmetric partitions: ``Partition(t0, t1, src="a")``
+    silences a's sends while a still hears everyone."""
+
+    start: float
+    end: float
+    src: Optional[object] = None
+    dst: Optional[object] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class KillRestart:
+    """Script a peer-process death: ``peer`` goes down at ``at`` and may be
+    restarted ``down_for`` seconds later. The socket layer ignores this
+    directive — killing a process is the HARNESS's job (close the socket,
+    drop the session, rebuild after the window; see tests/test_chaos.py) —
+    but carrying it in the plan keeps the whole failure script in one
+    replayable artifact."""
+
+    at: float
+    peer: object
+    down_for: float
+
+
+Directive = Union[LossBurst, Reorder, Duplicate, Corrupt, Partition, KillRestart]
+
+_KINDS = {
+    "loss": LossBurst,
+    "reorder": Reorder,
+    "duplicate": Duplicate,
+    "corrupt": Corrupt,
+    "partition": Partition,
+    "kill_restart": KillRestart,
+}
+_NAMES = {cls: name for name, cls in _KINDS.items()}
+
+
+def _addr_to_json(addr):
+    # (host, port) tuples survive JSON as lists; normalize on load instead.
+    return addr
+
+
+def _addr_from_json(addr):
+    if isinstance(addr, list):
+        return tuple(addr)
+    return addr
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosPlan:
+    seed: int
+    directives: Tuple[Directive, ...] = ()
+
+    def __post_init__(self):
+        object.__setattr__(self, "directives", tuple(self.directives))
+
+    # -- queries ---------------------------------------------------------
+
+    def active(self, kind, now: float) -> List[Directive]:
+        return [
+            d
+            for d in self.directives
+            if isinstance(d, kind) and d.start <= now < d.end
+        ]
+
+    def partitioned(self, src, dst, now: float) -> bool:
+        for d in self.directives:
+            if not isinstance(d, Partition) or not d.start <= now < d.end:
+                continue
+            if (d.src is None or d.src == src) and (
+                d.dst is None or d.dst == dst
+            ):
+                return True
+        return False
+
+    def kill_restarts(self) -> List[KillRestart]:
+        return sorted(
+            (d for d in self.directives if isinstance(d, KillRestart)),
+            key=lambda d: d.at,
+        )
+
+    def horizon(self) -> float:
+        """Time at which the last directive has expired/healed."""
+        t = 0.0
+        for d in self.directives:
+            t = max(t, d.at + d.down_for if isinstance(d, KillRestart) else d.end)
+        return t
+
+    # -- (de)serialization: the replay artifact --------------------------
+
+    def to_json(self) -> str:
+        out = []
+        for d in self.directives:
+            entry = {"kind": _NAMES[type(d)]}
+            for f in dataclasses.fields(d):
+                v = getattr(d, f.name)
+                entry[f.name] = _addr_to_json(v) if f.name in (
+                    "src", "dst", "peer"
+                ) else v
+            out.append(entry)
+        return json.dumps({"seed": self.seed, "directives": out}, indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "ChaosPlan":
+        raw = json.loads(text)
+        directives = []
+        for entry in raw["directives"]:
+            entry = dict(entry)
+            kind = _KINDS[entry.pop("kind")]
+            for k in ("src", "dst", "peer"):
+                if k in entry:
+                    entry[k] = _addr_from_json(entry[k])
+            directives.append(kind(**entry))
+        return cls(int(raw["seed"]), tuple(directives))
+
+    # -- generation ------------------------------------------------------
+
+    @classmethod
+    def generate(
+        cls,
+        seed: int,
+        duration: float,
+        peers: Tuple[object, ...] = (),
+        kill_restart: bool = False,
+    ) -> "ChaosPlan":
+        """A deterministic mixed-fault schedule over ``duration`` seconds:
+        a few loss bursts, one reorder window, one duplication window, one
+        light corruption window, one asymmetric partition with a heal
+        window, and (opt-in) one peer kill/restart. Same ``(seed, duration,
+        peers)`` -> same plan, always."""
+        rng = np.random.RandomState(seed & 0x7FFFFFFF)
+        span = max(float(duration), 1.0)
+        d: List[Directive] = []
+        for _ in range(3):
+            t0 = float(rng.uniform(0.05 * span, 0.85 * span))
+            d.append(LossBurst(t0, t0 + float(rng.uniform(0.02, 0.06) * span),
+                               float(rng.uniform(0.1, 0.4))))
+        t0 = float(rng.uniform(0.1 * span, 0.7 * span))
+        d.append(Reorder(t0, t0 + 0.1 * span, float(rng.uniform(0.1, 0.3)),
+                         delay=float(rng.uniform(0.02, 0.08))))
+        t0 = float(rng.uniform(0.1 * span, 0.7 * span))
+        d.append(Duplicate(t0, t0 + 0.1 * span, float(rng.uniform(0.1, 0.3))))
+        t0 = float(rng.uniform(0.1 * span, 0.7 * span))
+        d.append(Corrupt(t0, t0 + 0.08 * span, float(rng.uniform(0.05, 0.15))))
+        if peers:
+            victim = peers[int(rng.randint(0, len(peers)))]
+            t0 = float(rng.uniform(0.2 * span, 0.5 * span))
+            # One-sided: victim's sends vanish, it still hears the others —
+            # the asymmetric shape that trips naive keepalive logic.
+            d.append(Partition(t0, t0 + float(rng.uniform(0.04, 0.1) * span),
+                               src=victim))
+            if kill_restart:
+                t0 = float(rng.uniform(0.6 * span, 0.8 * span))
+                d.append(KillRestart(t0, victim,
+                                     float(rng.uniform(0.05, 0.1) * span)))
+        return cls(seed, tuple(d))

@@ -292,7 +292,13 @@ class FusedTickExecutor:
         a = self._i32_cache.get(v)
         if a is None:
             if len(self._i32_cache) > 65536:  # frame numbers are unbounded
-                self._i32_cache.clear()
+                # Evict only the unbounded frame-number keys; small
+                # constants (branch counts, depths, span lengths < 4096)
+                # are the per-tick hot set and repopulating them after a
+                # blanket clear() costs a host->device transfer burst on
+                # the dispatch path.
+                for k in [k for k in self._i32_cache if not 0 <= k < 4096]:
+                    del self._i32_cache[k]
             a = jnp.asarray(v, jnp.int32)
             self._i32_cache[v] = a
         return a

@@ -31,7 +31,12 @@ import numpy as np
 
 from bevy_ggrs_tpu.rollout import RolloutExecutor
 from bevy_ggrs_tpu.schedule import Schedule
-from bevy_ggrs_tpu.session.requests import AdvanceFrame, LoadGameState, SaveGameState
+from bevy_ggrs_tpu.session.requests import (
+    AdvanceFrame,
+    LoadGameState,
+    RestoreGameState,
+    SaveGameState,
+)
 from bevy_ggrs_tpu.state import WorldState, combine64, ring_init, to_host
 
 
@@ -91,9 +96,21 @@ class RollbackRunner:
 
     def handle_requests(self, requests: Sequence[object], session=None) -> None:
         """Execute a request list in order (`ggrs_stage.rs:259-269`
-        semantics), fused per Load-delimited segment."""
-        segments = self._segment(requests)
-        for load_frame, steps in segments:
+        semantics), fused per Load-delimited segment. ``RestoreGameState``
+        (supervisor recovery) splits the list: everything before it executes
+        first, then the restore replaces state/ring/frame, then execution
+        resumes from the adopted frame."""
+        batch: List[object] = []
+        for req in requests:
+            if isinstance(req, RestoreGameState):
+                if batch:
+                    for load_frame, steps in self._segment(batch):
+                        self._run_segment(load_frame, steps, session)
+                    batch = []
+                self.restore_state(req.frame, req.state)
+            else:
+                batch.append(req)
+        for load_frame, steps in self._segment(batch):
             self._run_segment(load_frame, steps, session)
 
     def _segment(
@@ -202,6 +219,30 @@ class RollbackRunner:
         self.frame = frame
 
     # ------------------------------------------------------------------
+
+    def restore_state(self, frame: int, state: WorldState) -> None:
+        """Adopt an external checkpoint (supervisor state transfer): the
+        world becomes ``state`` at driver frame ``frame``, and the snapshot
+        ring is re-seeded from it (prior slots reference the abandoned
+        timeline — a Load into them would resurrect the divergent state the
+        transfer just repaired). Any speculation cache is invalidated for
+        the same reason."""
+        import jax
+        import jax.numpy as jnp
+
+        self.state = jax.tree.map(jnp.asarray, state)
+        self.ring = ring_init(self.state, self.max_prediction + 1)
+        self.frame = int(frame)
+        if self._input_log is not None:
+            # Logged as-used inputs for frames past the checkpoint belong to
+            # the abandoned timeline's replay; the post-restore replay
+            # re-logs them.
+            for f in [f for f in self._input_log if f >= frame]:
+                del self._input_log[f]
+        invalidate = getattr(self, "invalidate_speculation", None)
+        if invalidate is not None:
+            invalidate()
+        self.metrics.count("state_restores")
 
     def warmup(self) -> None:
         """Compile the fused rollout executable before the session goes

@@ -84,6 +84,11 @@ class EventKind(enum.Enum):
     # data: attestation detail incl. effective coverage; run with
     # GGRS_ATTEST_EXHAUSTIVE=1 to restore full real-executable coverage.
     ATTESTATION_DEGRADED = "attestation_degraded"
+    # Extensions for the self-healing supervisor (docs/chaos.md): ggrs stops
+    # at DESYNC_DETECTED / DISCONNECTED; these report the repair lifecycle.
+    PLAYER_REJOINED = "player_rejoined"  # data: {"handle": h}
+    QUARANTINED = "quarantined"  # local peer lost the checksum vote
+    RECOVERED = "recovered"  # quarantine healed via state transfer
 
 
 @dataclasses.dataclass(frozen=True)

@@ -29,6 +29,10 @@ from bevy_ggrs_tpu.session.common import (
 
 NUM_SYNC_ROUNDTRIPS = 5
 SYNC_RETRY_INTERVAL = 0.2
+# Unanswered sync requests back off exponentially (base interval doubling per
+# failure) up to this cap, with 0-25% jitter so two peers restarting together
+# don't stay phase-locked. Progress (any SyncReply) resets the backoff.
+SYNC_RETRY_MAX = 5.0
 QUALITY_REPORT_INTERVAL = 0.2
 KEEP_ALIVE_INTERVAL = 0.2
 # (Checksum-exchange cadence is session config: P2PSession.desync_interval,
@@ -68,12 +72,21 @@ class PeerEndpoint:
         self._sync_remaining = NUM_SYNC_ROUNDTRIPS
         self._sync_nonce: Optional[int] = None
         self._last_sync_sent = -1e9
+        self._sync_failures = 0  # unanswered sync sends (drives backoff)
+        # True on endpoints the session re-created to chase a dead peer
+        # (reconnect_peer): lets advance_frame skip queuing inputs to a peer
+        # that may never come back, and marks the eventual SYNCHRONIZED as a
+        # rejoin rather than a first join.
+        self.reconnecting = False
 
         # Outgoing input spans, per local handle: frame -> bits (unacked).
         self._pending_output: Dict[int, Dict[int, np.ndarray]] = {}
         # Highest frame actually TRANSMITTED per handle: bounds acceptable
         # acks (a peer cannot have received what we never sent).
         self._max_sent: Dict[int, int] = {}
+        # Latest ack VALUE the peer claimed per handle (unclamped — see
+        # _ack/refill_range for the ack-corruption healing loop).
+        self._last_ack_rx: Dict[int, int] = {}
         # Handles we relay on behalf of a disconnected peer: the generic
         # piggybacked ack in InputMsg covers only the sender's OWN handles,
         # so relayed handles are trimmed exclusively by explicit InputAcks.
@@ -95,6 +108,10 @@ class PeerEndpoint:
 
         # Remote checksum reports for desync detection: frame -> checksum.
         self.remote_checksums: Dict[int, int] = {}
+
+        # Supervisor-bound control messages (StateRequest / StateChunk):
+        # the session drains these into its own control inbox each poll.
+        self.control_inbox: List[proto.Message] = []
 
         # Version-skew accounting (the datagrams themselves are dropped).
         self.version_mismatches = 0
@@ -122,7 +139,13 @@ class PeerEndpoint:
         """Drive timers: sync retries, quality reports, keepalives,
         disconnect detection."""
         if self.state == PeerState.SYNCHRONIZING:
-            if now - self._last_sync_sent >= SYNC_RETRY_INTERVAL:
+            interval = min(
+                SYNC_RETRY_INTERVAL * (2.0 ** self._sync_failures),
+                SYNC_RETRY_MAX,
+            ) * (1.0 + 0.25 * float(self._rng.random_sample()))
+            if now - self._last_sync_sent >= interval:
+                if self._last_sync_sent > -1e9:
+                    self._sync_failures += 1  # previous request went unanswered
                 self._sync_nonce = int(self._rng.randint(0, 2**31))
                 self._send(proto.SyncRequest(self._sync_nonce), now)
                 self._last_sync_sent = now
@@ -174,6 +197,7 @@ class PeerEndpoint:
             ):
                 self._sync_remaining -= 1
                 self._last_sync_sent = -1e9  # send next roundtrip immediately
+                self._sync_failures = 0  # progress: reset the backoff
                 if self._sync_remaining <= 0:
                     self.state = PeerState.RUNNING
                     self._last_recv = now
@@ -187,7 +211,16 @@ class PeerEndpoint:
                         },
                     )
         elif isinstance(msg, proto.InputMsg):
-            self.remote_frame = max(self.remote_frame, msg.sender_frame)
+            # Latest claim, NOT a running max: a single corrupted
+            # sender_frame would poison a max() forever (wedging timesync
+            # and catch-up heuristics on a bogus huge frame), while under
+            # plain reordering the dip lasts one datagram. Negative claims
+            # are impossible (frames start at 0) and would flip the local
+            # advantage past the int16 wire field, so drop those outright;
+            # a bogus *positive* claim only zeroes the advantage until the
+            # next genuine message overwrites it.
+            if msg.sender_frame >= 0:
+                self.remote_frame = msg.sender_frame
             self.remote_advantage = msg.advantage
             for h in list(self._pending_output):
                 if h not in self._relay_handles:
@@ -207,6 +240,12 @@ class PeerEndpoint:
             if len(self.remote_checksums) > 64:
                 for f in sorted(self.remote_checksums)[:-64]:
                     del self.remote_checksums[f]
+        elif isinstance(msg, (proto.StateRequest, proto.StateChunk)):
+            # Recovery traffic is the supervisor's business, not the
+            # endpoint's: park it for the session to drain.
+            self.control_inbox.append(msg)
+            if len(self.control_inbox) > 256:  # bound if nothing drains
+                del self.control_inbox[:-256]
         # KeepAlive: nothing beyond the last_recv bump.
 
     def note_undecodable(self, data: bytes) -> None:
@@ -256,6 +295,11 @@ class PeerEndpoint:
         pending = self._pending_output.get(handle)
         if pending is None:
             return
+        # Latest CLAIMED frontier, unclamped: a corrupted (lying-high) ack
+        # trims pending below, but the next genuine ack then lands under
+        # the trimmed buffer and refill_range() re-queues the lost frames
+        # from session history (self-healing against ack corruption).
+        self._last_ack_rx[handle] = ack_frame
         # A peer cannot legitimately ack frames we never TRANSMITTED: a
         # lying ack-ahead (buggy peer or source spoof) would otherwise trim
         # input history before its first send and permanently stall the
@@ -269,9 +313,31 @@ class PeerEndpoint:
     def queue_input(
         self, handle: int, frame: int, bits: np.ndarray, relay: bool = False
     ) -> None:
-        self._pending_output.setdefault(handle, {})[frame] = np.asarray(bits)
+        pending = self._pending_output.setdefault(handle, {})
+        pending[frame] = np.asarray(bits)
         if relay:
             self._relay_handles.add(handle)
+        if self.state != PeerState.RUNNING and len(pending) > MAX_INPUT_SPAN:
+            # A handshaking (reconnect) endpoint has no acks flowing, so
+            # its buffer would grow as long as the peer stays away. Keep
+            # only the newest span's worth: a rejoiner that far behind
+            # restores the older history from a state transfer anyway.
+            for f in sorted(pending)[: len(pending) - MAX_INPUT_SPAN]:
+                del pending[f]
+
+    def refill_range(self, handle: int) -> Optional[Tuple[int, int]]:
+        """``(start, end)`` of frames the peer still claims to need but
+        that are no longer pending — the wake of a corrupted lying-high
+        ack that trimmed them before the peer received them. The session
+        re-queues them from its own input history; None when healthy."""
+        pending = self._pending_output.get(handle)
+        claimed = self._last_ack_rx.get(handle)
+        if pending is None or claimed is None:
+            return None
+        nxt = min(pending) if pending else self._max_sent.get(handle, -1) + 1
+        if claimed + 1 < nxt:
+            return claimed + 1, nxt
+        return None
 
     def send_pending_inputs(
         self, now: float, local_frame: int, local_advantage: int, ack_frame: int

@@ -127,6 +127,11 @@ class P2PSession:
         self._disconnected: Dict[int, int] = {}  # handle -> frame of disconnect
 
         rng = np.random.RandomState(seed)
+        # Kept for reconnect_peer: replacement endpoints share the session
+        # RNG stream and the original timeout knobs.
+        self._rng = rng
+        self._disconnect_timeout = disconnect_timeout
+        self._disconnect_notify_start = disconnect_notify_start
         self._endpoints: Dict[object, PeerEndpoint] = {}
         for addr in set(remote_players.values()) | set(spectators):
             self._endpoints[addr] = PeerEndpoint(
@@ -145,6 +150,11 @@ class P2PSession:
         self._local_checksums: Dict[int, int] = {}
         self._last_checksum_sent = NULL_FRAME
         self._desynced_frames: set = set()
+        # Supervisor surfaces: state-transfer messages parked by endpoints
+        # ((addr, msg) pairs, see drain_control) and the per-settled-frame
+        # checksum ballot used to pick the desync-vote winner.
+        self._control_inbox: List = []
+        self._checksum_votes: Dict[int, Dict[object, int]] = {}
 
     # ------------------------------------------------------------------
     # Introspection (stage-driver surface, survey §2.2)
@@ -156,6 +166,15 @@ class P2PSession:
         player_addrs = set(self._handle_addr.values())
         for addr in player_addrs:
             if self._endpoints[addr].state == PeerState.SYNCHRONIZING:
+                # A reconnect endpoint chasing a dead peer (every handle at
+                # this addr already in _disconnected) must not re-gate the
+                # survivors: the match goes on with frozen inputs until the
+                # peer actually answers the re-handshake.
+                handles = [
+                    h for h, a in self._handle_addr.items() if a == addr
+                ]
+                if handles and all(h in self._disconnected for h in handles):
+                    continue
                 return SessionState.SYNCHRONIZING
         return SessionState.RUNNING
 
@@ -242,6 +261,13 @@ class P2PSession:
                 self._on_peer_disconnected(addr)
             ack = self._ack_frame_for(addr)
             ep.send_pending_inputs(now, self.current_frame, local_adv, ack)
+            if ep.control_inbox:
+                self._control_inbox.extend(
+                    (addr, m) for m in ep.control_inbox
+                )
+                ep.control_inbox.clear()
+                if len(self._control_inbox) > 256:
+                    del self._control_inbox[:-256]
             self._events.extend(ep.events)
             ep.events.clear()
             for data in ep.outbox:
@@ -254,6 +280,50 @@ class P2PSession:
                 SessionEvent(EventKind.WAIT_RECOMMENDATION, data={"skip_frames": ahead})
             )
 
+    # ------------------------------------------------------------------
+    # Supervisor surface (session/supervisor.py)
+
+    def drain_control(self) -> List:
+        """Take every parked state-transfer message as (addr, msg) pairs.
+        The supervisor (not the session) owns recovery policy."""
+        out, self._control_inbox = self._control_inbox, []
+        return out
+
+    def send_control(self, addr: object, msg: proto.Message) -> None:
+        """Send a state-transfer message directly (bypasses the endpoint
+        outbox: recovery traffic must flow even to SYNCHRONIZING/quarantined
+        peers the normal input path won't talk to)."""
+        self.socket.send_to(proto.encode(msg), addr)
+
+    def checksum_votes(self, frame: int, pop: bool = False) -> Dict[object, int]:
+        """Every remote peer's reported checksum for a settled exchange
+        frame (addr -> checksum), recorded by ``_check_desync`` for
+        agreeing AND mismatching peers alike — the ballot the supervisor
+        uses to decide which side of a desync is the minority."""
+        votes = self._checksum_votes.get(frame, {})
+        if pop:
+            self._checksum_votes.pop(frame, None)
+        return dict(votes)
+
+    def reconnect_peer(self, addr: object) -> bool:
+        """Replace a DISCONNECTED peer's endpoint with a fresh
+        SYNCHRONIZING one so a restarted process at the same address can
+        re-handshake mid-match. The dead peer's handles stay in
+        ``_disconnected`` (frozen inputs) until its confirmed inputs start
+        flowing again (see the readmit path in ``_on_remote_inputs``)."""
+        ep = self._endpoints.get(addr)
+        if ep is None or ep.state != PeerState.DISCONNECTED:
+            return False
+        fresh = PeerEndpoint(
+            addr,
+            self._rng,
+            disconnect_timeout=self._disconnect_timeout,
+            disconnect_notify_start=self._disconnect_notify_start,
+        )
+        fresh.reconnecting = True
+        self._endpoints[addr] = fresh
+        return True
+
     def _local_advantage(self) -> int:
         """Our frame advantage over the slowest running peer (sent in input
         msgs / quality reports for the peer's own frames_ahead)."""
@@ -261,7 +331,10 @@ class P2PSession:
         for ep in self._endpoints.values():
             if ep.state == PeerState.RUNNING and ep.remote_frame != NULL_FRAME:
                 adv = max(adv, self.current_frame - ep.remote_frame)
-        return adv
+        # The advantage rides an int16 wire field; a remote_frame briefly
+        # seeded by a corrupted datagram must skew timesync, not crash the
+        # encoder.
+        return min(adv, 0x7FFF)
 
     def _ack_frame_for(self, addr: object) -> int:
         handles = [h for h, a in self._handle_addr.items() if a == addr]
@@ -279,10 +352,18 @@ class P2PSession:
         relayed = sender != owner
         if relayed:
             # Handle-ownership check: a peer may only speak for its own
-            # players — except survivors relaying a DISCONNECTED player's
-            # confirmed inputs (see _relay_disconnected_inputs).
+            # players — except survivors relaying a quarantined-or-dead
+            # player's confirmed inputs (see _relay_disconnected_inputs).
+            # `h in _disconnected` also admits the window where the owner's
+            # replacement endpoint is back to RUNNING but its own confirmed
+            # stream hasn't caught up past the relayed tail yet.
             owner_ep = self._endpoints.get(owner)
-            if owner_ep is None or owner_ep.state != PeerState.DISCONNECTED:
+            dead = (
+                owner_ep is None
+                or owner_ep.state == PeerState.DISCONNECTED
+                or h in self._disconnected
+            )
+            if not dead:
                 return
             if sender in self._spectator_addrs:
                 return  # spectators never contribute inputs
@@ -296,6 +377,27 @@ class P2PSession:
                 break  # gap (loss beyond span) — wait for next resend
             queue.add_input(frame, bits)
             self._note_confirmed(h, frame, queue.confirmed(frame))
+        if (
+            not relayed
+            and h in self._disconnected
+            and self._endpoints[owner].state == PeerState.RUNNING
+            and queue.last_confirmed_frame >= self._disconnected[h]
+        ):
+            # Readmit: the owner re-handshook (reconnect_peer) and its OWN
+            # confirmed stream reached the disconnect point, so its inputs
+            # are no longer frozen. Deleting the entry flips this handle's
+            # status back to live in subsequent gathers only — already
+            # simulated frames keep their recorded DISCONNECTED status, and
+            # game systems never read status into state (docs/parity.md),
+            # so peers readmitting at different frames stay bitwise equal.
+            del self._disconnected[h]
+            self._events.append(
+                SessionEvent(
+                    EventKind.PLAYER_REJOINED,
+                    addr=owner,
+                    data={"handle": h},
+                )
+            )
         if relayed and queue.last_confirmed_frame >= 0:
             # Relayed handles are outside the piggybacked-ack path: ack
             # explicitly so the relaying survivor can trim its span.
@@ -516,6 +618,11 @@ class P2PSession:
                     continue  # keep until our own checksum is final
                 remote = ep.remote_checksums[frame]
                 local = self._local_checksums.get(frame)
+                # Ballot for the supervisor's majority vote: record every
+                # settled compared report, agreeing peers included — a
+                # 2-vs-1 desync is only decidable when the agreeing peer's
+                # vote is on file too.
+                self._checksum_votes.setdefault(frame, {})[ep.addr] = remote
                 if (
                     local is not None
                     and local != remote
@@ -530,6 +637,9 @@ class P2PSession:
                         )
                     )
                 del ep.remote_checksums[frame]
+        horizon = self.confirmed_frame() - 8 * max(self.desync_interval, 1)
+        for f in [f for f in self._checksum_votes if f < horizon]:
+            del self._checksum_votes[f]
 
     # ------------------------------------------------------------------
     # Input + advance (the protocol heart)
@@ -571,12 +681,33 @@ class P2PSession:
                     continue  # spectators get the confirmed fan-out instead
                 if ep.state == PeerState.DISCONNECTED:
                     continue  # never queue to the dead — unbounded growth
+                # Reconnect endpoints buffer too (bounded inside
+                # queue_input): a rejoiner's state checkpoint is cut the
+                # moment WE serve it, so every input we produce while its
+                # handshake is still in flight must reach it as a span or
+                # the frontier gaps and both sides deadlock at the
+                # prediction window.
                 for f in range(
                     max(0, target - (self._queues[h].delay or 0)), target + 1
                 ):
                     got = self._queues[h].confirmed(f)
                     if got is not None:
                         ep.queue_input(h, f, got)
+                refill = ep.refill_range(h)
+                if refill is not None:
+                    # A corrupted lying-high ack trimmed frames the peer
+                    # never received; restore them from our own input
+                    # history (bounded by the _gc retention window) so the
+                    # peer's frontier can't gap permanently.
+                    start = max(
+                        refill[0],
+                        0,
+                        self.current_frame - 2 * self.max_prediction - 1,
+                    )
+                    for f in range(start, refill[1]):
+                        got = self._queues[h].confirmed(f)
+                        if got is not None:
+                            ep.queue_input(h, f, got)
         self._pending_local.clear()
 
         requests: List[object] = []
@@ -584,6 +715,15 @@ class P2PSession:
         # Rollback: a confirmed input contradicted a prediction.
         rollback_to = self._tracker.first_incorrect
         if rollback_to != NULL_FRAME:
+            floor = frame - self.max_prediction
+            if rollback_to < floor:
+                # Deeper than the snapshot ring reaches — possible only
+                # when late inputs contradict a frame we already settled
+                # with a frozen prediction (a readmitted peer that never
+                # actually died). Roll back as far as snapshots exist; the
+                # residual divergence is exactly what desync detection +
+                # the supervisor's state resync repair.
+                rollback_to = floor
             requests.append(LoadGameState(rollback_to))
             for f in range(rollback_to, frame):
                 requests.append(SaveGameState(f))
@@ -650,7 +790,11 @@ class P2PSession:
         spectator fan-out."""
         horizon = min(
             self.confirmed_frame(),
-            self.current_frame - self.max_prediction - 1,
+            # Two windows, not one: a quarantined peer replays from a donor
+            # snapshot cut at the DONOR's confirmed frontier, which can lag
+            # ours by most of a prediction window under loss — the replay
+            # gathers those older frames from these queues.
+            self.current_frame - 2 * self.max_prediction - 1,
             self._spectator_floor(),
         )
         self._qset.discard_before(horizon)

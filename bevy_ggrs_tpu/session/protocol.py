@@ -41,6 +41,15 @@ T_QUALITY_REPORT = 5
 T_QUALITY_REPLY = 6
 T_KEEP_ALIVE = 7
 T_CHECKSUM_REPORT = 8
+# State-transfer pair (supervisor recovery path). New types need NO version
+# bump: an old peer's decode() returns None for unknown type bytes and drops
+# the datagram, so mixed deployments degrade to "no recovery", not desync.
+T_STATE_REQUEST = 9
+T_STATE_CHUNK = 10
+
+# StateRequest.kind values.
+STATE_KIND_RING = 0  # world snapshot at one settled frame (desync resync)
+STATE_KIND_FULL = 1  # full runner+session checkpoint (crash-restart rejoin)
 
 _HDR = struct.Struct("<BBB")  # magic, version, type
 
@@ -121,15 +130,45 @@ class ChecksumReport:
     checksum: int
 
 
+@dataclasses.dataclass(frozen=True)
+class StateRequest:
+    """Ask a healthy peer for a state checkpoint (supervisor recovery).
+    ``nonce`` identifies the transfer (the requester's retry key);
+    ``resend_from`` lets a retry skip chunks already received."""
+
+    nonce: int
+    kind: int  # STATE_KIND_RING | STATE_KIND_FULL
+    resend_from: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class StateChunk:
+    """One fragment of a serialized checkpoint. ``checksum`` is the 64-bit
+    semantic digest of the DECODED world state (the transfer's signature:
+    the receiver recomputes it after restore and rejects a tampered or
+    corrupted payload); ``crc`` guards the individual fragment's bytes."""
+
+    nonce: int
+    kind: int
+    frame: int
+    checksum: int  # u64 semantic digest of the whole decoded state
+    seq: int
+    total: int
+    crc: int  # crc32 of this chunk's payload bytes
+    payload: bytes
+
+
 Message = Union[
     SyncRequest, SyncReply, InputMsg, InputAck, QualityReport, QualityReply,
-    KeepAlive, ChecksumReport,
+    KeepAlive, ChecksumReport, StateRequest, StateChunk,
 ]
 
 _U32 = struct.Struct("<I")
 _I32U64 = struct.Struct("<iQ")
 _BI = struct.Struct("<Bi")
 _IH = struct.Struct("<Ih")
+_STATE_REQ = struct.Struct("<IBi")  # nonce, kind, resend_from
+_STATE_CHUNK = struct.Struct("<IBiQHHI")  # nonce kind frame checksum seq total crc
 
 
 def encode(msg: Message) -> bytes:
@@ -156,6 +195,24 @@ def encode(msg: Message) -> bytes:
     if isinstance(msg, ChecksumReport):
         return _HDR.pack(MAGIC, VERSION, T_CHECKSUM_REPORT) + _I32U64.pack(
             msg.frame, msg.checksum & 0xFFFFFFFFFFFFFFFF
+        )
+    if isinstance(msg, StateRequest):
+        return _HDR.pack(MAGIC, VERSION, T_STATE_REQUEST) + _STATE_REQ.pack(
+            msg.nonce & 0xFFFFFFFF, msg.kind, msg.resend_from
+        )
+    if isinstance(msg, StateChunk):
+        return (
+            _HDR.pack(MAGIC, VERSION, T_STATE_CHUNK)
+            + _STATE_CHUNK.pack(
+                msg.nonce & 0xFFFFFFFF,
+                msg.kind,
+                msg.frame,
+                msg.checksum & 0xFFFFFFFFFFFFFFFF,
+                msg.seq,
+                msg.total,
+                msg.crc & 0xFFFFFFFF,
+            )
+            + msg.payload
         )
     raise TypeError(f"unknown message {msg!r}")
 
@@ -202,6 +259,16 @@ def decode(data: bytes) -> Optional[Message]:
         if mtype == T_CHECKSUM_REPORT:
             f, cs = _I32U64.unpack_from(body)
             return ChecksumReport(f, cs)
+        if mtype == T_STATE_REQUEST:
+            nonce, kind, resend = _STATE_REQ.unpack_from(body)
+            return StateRequest(nonce, kind, resend)
+        if mtype == T_STATE_CHUNK:
+            nonce, kind, frame, cs, seq, total, crc = _STATE_CHUNK.unpack_from(
+                body
+            )
+            return StateChunk(
+                nonce, kind, frame, cs, seq, total, crc, body[_STATE_CHUNK.size :]
+            )
         return None
     except struct.error:
         return None

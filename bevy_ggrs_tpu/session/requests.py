@@ -40,6 +40,20 @@ class LoadGameState:
 
 
 @dataclasses.dataclass(frozen=True)
+class RestoreGameState:
+    """Adopt an externally supplied world (supervisor state transfer, not
+    the ring): set the driver frame to ``frame``, replace the device state
+    with ``state``, and re-seed the snapshot ring from it. Outside the
+    reference's request vocabulary — ggrs stops at DesyncDetected; this is
+    the repair path (docs/chaos.md). Unlike ``LoadGameState`` there is no
+    within-``max_prediction`` bound: the adopted frame replaces history
+    rather than rewinding into it."""
+
+    frame: int
+    state: object  # WorldState pytree (host or device arrays)
+
+
+@dataclasses.dataclass(frozen=True)
 class AdvanceFrame:
     """Run one simulated frame with these per-player inputs
     (`ggrs_stage.rs:301-306`). ``bits[p]`` payload, ``status[p]`` ∈

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import zlib
 from typing import List, Optional
 
 import jax
@@ -785,6 +786,15 @@ class SpeculativeRollbackRunner(RollbackRunner):
     # ------------------------------------------------------------------
 
     def handle_requests(self, requests, session=None) -> None:
+        from bevy_ggrs_tpu.session.requests import RestoreGameState
+
+        if any(isinstance(r, RestoreGameState) for r in requests):
+            # Supervisor recovery path: the base splitter applies the
+            # restore (which invalidates speculation) between batches; no
+            # speculative commit can span it.
+            super().handle_requests(requests, session)
+            self._gc_log()
+            return
         segments = self._segment(requests)
         for load_frame, steps in segments:
             if load_frame is not None and self._try_commit(
@@ -905,6 +915,7 @@ class SpeculativeRollbackRunner(RollbackRunner):
             sig = (
                 anchor, np.asarray(last).tobytes(),
                 known.tobytes(), known_mask.tobytes(),
+                self._history_fingerprint(anchor),
             )
             # Dedup-skip STEADY ticks only: a rollback tick already ran
             # (and charged) the branch match above — delegating it to the
@@ -1069,16 +1080,19 @@ class SpeculativeRollbackRunner(RollbackRunner):
             known, known_mask = self._known_inputs(anchor, session)
         if anchor < self.frame and self._sampler is None:
             # The anchor state is ring-fixed (a past frame) and the
-            # structured tree is deterministic in (anchor, last, known),
-            # so a rollout from the same signature is the SAME rollout —
-            # skip the redundant device dispatch. (When anchor ==
-            # self.frame the anchor state is the live state, which moves
-            # every tick; with a random sampler each dispatch draws FRESH
-            # branches, whose compounding hit probability the skip would
-            # destroy — no dedup in either case.)
+            # structured tree is deterministic in (anchor, last, known)
+            # plus the input-log window it ranks candidates and detects
+            # periods from (folded in as the history fingerprint), so a
+            # rollout from the same signature is the SAME rollout — skip
+            # the redundant device dispatch. (When anchor == self.frame
+            # the anchor state is the live state, which moves every tick;
+            # with a random sampler each dispatch draws FRESH branches,
+            # whose compounding hit probability the skip would destroy —
+            # no dedup in either case.)
             sig = (
                 anchor, np.asarray(last).tobytes(),
                 known.tobytes(), known_mask.tobytes(),
+                self._history_fingerprint(anchor),
             )
             if self._result is not None and sig == self._spec_sig:
                 self.spec_dispatches_skipped += 1
@@ -1334,6 +1348,27 @@ class SpeculativeRollbackRunner(RollbackRunner):
             valid[h, k, : len(row)] = True
         return C, valid
 
+    def _history_fingerprint(self, anchor: int) -> tuple:
+        """Digest of everything the structured branch tree reads from the
+        input log: the max logged frame (the recency ranking in
+        :meth:`_candidate_values` keys on the latest 32 logged frames) and
+        a hash of the contiguous ≤48-frame window ending at ``anchor - 1``
+        (the periodic-extrapolation input). The dedup signatures fold this
+        in so a SHIFTED history window — same (anchor, last, known) but new
+        log contents — can't pin a stale branch tree."""
+        L = anchor - 1
+        start = L
+        while start - 1 in self._input_log and L - (start - 1) < 48:
+            start -= 1
+        digest = 0
+        for f in range(start, L + 1):
+            got = self._input_log.get(f)
+            if got is not None:
+                digest = zlib.crc32(
+                    np.ascontiguousarray(got).tobytes(), digest
+                )
+        return (max(self._input_log, default=-1), start, digest)
+
     def _extrapolate_base(
         self, base: np.ndarray, known: np.ndarray, known_mask: np.ndarray,
         anchor: int,
@@ -1366,10 +1401,19 @@ class SpeculativeRollbackRunner(RollbackRunner):
             for f in frames
         ])  # [W, P, K]
         predf = base.reshape(F, P, n_field).copy()
+        universe = np.asarray(self._branch_values, dtype=hist.dtype).reshape(-1)
         found = False
         for h in range(P):
             for k in range(n_field):
                 seq = hist[:, h, k]
+                # Extrapolation REPLAYS history values as predictions, so a
+                # history containing out-of-contract values (outside the
+                # declared `_branch_values` universe the warmup attestation
+                # sampled) would smuggle them into branch bases. Skip the
+                # (player, field): repeat-last keeps the unavoidable
+                # branch-0 exposure and nothing more.
+                if universe.size and not np.isin(seq, universe).all():
+                    continue
                 n = seq.shape[0]
                 period = 0
                 for p in range(2, min(16, n // 2) + 1):

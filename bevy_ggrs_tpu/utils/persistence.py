@@ -21,6 +21,7 @@ SyncTest continues to produce the same checksums as an uninterrupted run.
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import re
@@ -46,10 +47,11 @@ def _flatten(tree) -> Tuple[List[str], List[Any], Any]:
     return paths, leaves, treedef
 
 
-def save_checkpoint(path: str, tree, metadata: Optional[Dict] = None) -> None:
-    """Write ``tree`` (any array pytree) + ``metadata`` atomically to
-    ``path`` (``.npz``). Atomic via rename so a crash mid-write never leaves
-    a truncated checkpoint behind."""
+def dumps_checkpoint(tree, metadata: Optional[Dict] = None) -> bytes:
+    """Serialize ``tree`` (any array pytree) + ``metadata`` to checkpoint
+    bytes (the ``.npz`` byte stream :func:`save_checkpoint` writes). The
+    bytes-level split exists for the supervisor's peer-to-peer state
+    transfer, which ships checkpoints over the wire instead of disk."""
     paths, leaves, _ = _flatten(tree)
     arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
     header = json.dumps(
@@ -60,12 +62,22 @@ def save_checkpoint(path: str, tree, metadata: Optional[Dict] = None) -> None:
         }
     )
     arrays[_HEADER_KEY] = np.frombuffer(header.encode(), dtype=np.uint8)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    return buf.getvalue()
+
+
+def save_checkpoint(path: str, tree, metadata: Optional[Dict] = None) -> None:
+    """Write ``tree`` (any array pytree) + ``metadata`` atomically to
+    ``path`` (``.npz``). Atomic via rename so a crash mid-write never leaves
+    a truncated checkpoint behind."""
+    blob = dumps_checkpoint(tree, metadata)
     directory = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(directory, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
-            np.savez_compressed(f, **arrays)
+            f.write(blob)
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
@@ -73,58 +85,84 @@ def save_checkpoint(path: str, tree, metadata: Optional[Dict] = None) -> None:
         raise
 
 
+def _validate_and_unflatten(data, template, name: str) -> Tuple[Any, Dict]:
+    header = json.loads(bytes(data[_HEADER_KEY]).decode())
+    # v1 is not rejected outright: the checksum widening shipped before
+    # the version bump, so v1 checkpoints exist in BOTH layouts. A v1
+    # file whose leaves validate is current-layout and loads normally;
+    # one whose ring checksums mismatch gets the explicit legacy error
+    # below instead of a generic shape message.
+    legacy_v1 = header.get("version") == 1
+    if not legacy_v1 and header.get("version") != _FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint {name!r}: format version "
+            f"{header.get('version')} != {_FORMAT_VERSION}"
+        )
+    t_paths, t_leaves, treedef = _flatten(template)
+    if header["paths"] != t_paths:
+        missing = set(t_paths) - set(header["paths"])
+        extra = set(header["paths"]) - set(t_paths)
+        raise ValueError(
+            f"checkpoint {name!r} does not match template: "
+            f"missing={sorted(missing)} extra={sorted(extra)}"
+        )
+    loaded = []
+    for i, (p, t_leaf) in enumerate(zip(t_paths, t_leaves)):
+        arr = data[f"leaf_{i}"]
+        t_arr = np.asarray(t_leaf)
+        if arr.shape != t_arr.shape or arr.dtype != t_arr.dtype:
+            if (
+                legacy_v1
+                and "checksums" in p
+                and arr.ndim + 1 == t_arr.ndim
+            ):
+                raise ValueError(
+                    f"checkpoint {name!r} predates 64-bit checksums "
+                    f"(leaf {p} is {list(arr.shape)}, now "
+                    f"uint32[depth, 2]) — re-save from a current "
+                    "session; pre-widening checkpoints cannot resume"
+                )
+            raise ValueError(
+                f"checkpoint leaf {p}: {arr.dtype}{list(arr.shape)} != "
+                f"template {t_arr.dtype}{list(t_arr.shape)}"
+            )
+        loaded.append(jnp.asarray(arr))
+    return jax.tree_util.tree_unflatten(treedef, loaded), header["metadata"]
+
+
+def loads_checkpoint(
+    data: bytes, template, name: str = "<bytes>"
+) -> Tuple[Any, Dict]:
+    """Inverse of :func:`dumps_checkpoint`: parse checkpoint bytes into the
+    structure of ``template`` with full path/shape/dtype validation (wire
+    payloads are as untrusted as disk files). ``name`` labels errors."""
+    with np.load(io.BytesIO(data)) as npz:
+        return _validate_and_unflatten(npz, template, name)
+
+
 def load_checkpoint(path: str, template) -> Tuple[Any, Dict]:
     """Read a checkpoint into the structure of ``template``; returns
     ``(tree, metadata)``. Every leaf is validated against the template's
     key path, shape, and dtype before any device transfer."""
     with np.load(path) as data:
-        header = json.loads(bytes(data[_HEADER_KEY]).decode())
-        # v1 is not rejected outright: the checksum widening shipped before
-        # the version bump, so v1 checkpoints exist in BOTH layouts. A v1
-        # file whose leaves validate is current-layout and loads normally;
-        # one whose ring checksums mismatch gets the explicit legacy error
-        # below instead of a generic shape message.
-        legacy_v1 = header.get("version") == 1
-        if not legacy_v1 and header.get("version") != _FORMAT_VERSION:
-            raise ValueError(
-                f"checkpoint {path!r}: format version "
-                f"{header.get('version')} != {_FORMAT_VERSION}"
-            )
-        t_paths, t_leaves, treedef = _flatten(template)
-        if header["paths"] != t_paths:
-            missing = set(t_paths) - set(header["paths"])
-            extra = set(header["paths"]) - set(t_paths)
-            raise ValueError(
-                f"checkpoint {path!r} does not match template: "
-                f"missing={sorted(missing)} extra={sorted(extra)}"
-            )
-        loaded = []
-        for i, (p, t_leaf) in enumerate(zip(t_paths, t_leaves)):
-            arr = data[f"leaf_{i}"]
-            t_arr = np.asarray(t_leaf)
-            if arr.shape != t_arr.shape or arr.dtype != t_arr.dtype:
-                if (
-                    legacy_v1
-                    and "checksums" in p
-                    and arr.ndim + 1 == t_arr.ndim
-                ):
-                    raise ValueError(
-                        f"checkpoint {path!r} predates 64-bit checksums "
-                        f"(leaf {p} is {list(arr.shape)}, now "
-                        f"uint32[depth, 2]) — re-save from a current "
-                        "session; pre-widening checkpoints cannot resume"
-                    )
-                raise ValueError(
-                    f"checkpoint leaf {p}: {arr.dtype}{list(arr.shape)} != "
-                    f"template {t_arr.dtype}{list(t_arr.shape)}"
-                )
-            loaded.append(jnp.asarray(arr))
-        return jax.tree_util.tree_unflatten(treedef, loaded), header["metadata"]
+        return _validate_and_unflatten(data, template, path)
 
 
 # ---------------------------------------------------------------------------
 # Runner integration
 # ---------------------------------------------------------------------------
+
+
+def _runner_meta(runner, metadata: Optional[Dict], session) -> Dict:
+    meta = dict(metadata or {})
+    meta.update(
+        frame=runner.frame,
+        rollbacks_total=runner.rollbacks_total,
+        rollback_frames_total=runner.rollback_frames_total,
+    )
+    if session is not None:
+        meta["session_state"] = session.state_dict()
+    return meta
 
 
 def save_runner(
@@ -136,32 +174,24 @@ def save_runner(
     frame counter and in-window input/checksum history are part of the
     resumable whole — a session restarted at frame 0 against a restored
     runner violates the save-frame invariant immediately."""
-    meta = dict(metadata or {})
-    meta.update(
-        frame=runner.frame,
-        rollbacks_total=runner.rollbacks_total,
-        rollback_frames_total=runner.rollback_frames_total,
+    save_checkpoint(
+        path,
+        {"state": runner.state, "ring": runner.ring},
+        _runner_meta(runner, metadata, session),
     )
-    if session is not None:
-        meta["session_state"] = session.state_dict()
-    save_checkpoint(path, {"state": runner.state, "ring": runner.ring}, meta)
 
 
-def restore_runner(path: str, runner, session=None) -> Dict:
-    """Restore ``runner`` (and optionally ``session``) in place from
-    :func:`save_runner` output; the runner must have been constructed with
-    the same registry, capacity, and ``max_prediction`` (leaf validation
-    enforces this). Returns the saved metadata.
-
-    All-or-nothing: everything that can raise (checkpoint validation, frame
-    parse, session restore) happens before the first runner field is
-    assigned, and a failing session restore rolls the session back to its
-    pre-call state — so a caller falling back to an older checkpoint
-    (``CheckpointManager.restore_latest``) never observes a runner at frame
-    N paired with a session at frame 0 (the save-frame invariant)."""
-    tree, meta = load_checkpoint(
-        path, {"state": runner.state, "ring": runner.ring}
+def dumps_runner(runner, metadata: Optional[Dict] = None, session=None) -> bytes:
+    """:func:`save_runner` to bytes instead of disk — the full-checkpoint
+    payload a healthy peer serves to a restarted one (STATE_KIND_FULL in
+    the supervisor's state transfer)."""
+    return dumps_checkpoint(
+        {"state": runner.state, "ring": runner.ring},
+        _runner_meta(runner, metadata, session),
     )
+
+
+def _apply_runner(tree, meta: Dict, runner, session) -> Dict:
     frame = int(meta["frame"])
     if session is not None:
         sd = meta.get("session_state")
@@ -190,6 +220,34 @@ def restore_runner(path: str, runner, session=None) -> Dict:
     if invalidate is not None:
         invalidate()
     return meta
+
+
+def restore_runner(path: str, runner, session=None) -> Dict:
+    """Restore ``runner`` (and optionally ``session``) in place from
+    :func:`save_runner` output; the runner must have been constructed with
+    the same registry, capacity, and ``max_prediction`` (leaf validation
+    enforces this). Returns the saved metadata.
+
+    All-or-nothing: everything that can raise (checkpoint validation, frame
+    parse, session restore) happens before the first runner field is
+    assigned, and a failing session restore rolls the session back to its
+    pre-call state — so a caller falling back to an older checkpoint
+    (``CheckpointManager.restore_latest``) never observes a runner at frame
+    N paired with a session at frame 0 (the save-frame invariant)."""
+    tree, meta = load_checkpoint(
+        path, {"state": runner.state, "ring": runner.ring}
+    )
+    return _apply_runner(tree, meta, runner, session)
+
+
+def loads_runner(data: bytes, runner, session=None) -> Dict:
+    """:func:`restore_runner` from :func:`dumps_runner` bytes — the
+    receiving half of the supervisor's full-checkpoint transfer. Same
+    all-or-nothing guarantees."""
+    tree, meta = loads_checkpoint(
+        data, {"state": runner.state, "ring": runner.ring}, "<transfer>"
+    )
+    return _apply_runner(tree, meta, runner, session)
 
 
 # ---------------------------------------------------------------------------

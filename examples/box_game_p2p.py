@@ -58,6 +58,14 @@ def main() -> int:
     parser.add_argument("--resume", action="store_true",
                         help="restore the newest checkpoint from "
                              "--checkpoint-dir before joining")
+    parser.add_argument("--chaos-seed", type=int, default=None, metavar="SEED",
+                        help="wrap the UDP socket in a seeded deterministic "
+                             "fault injector (loss bursts, reorder, dup, "
+                             "corruption — bevy_ggrs_tpu.chaos); same seed "
+                             "replays the same fault schedule")
+    parser.add_argument("--chaos-duration", type=float, default=None,
+                        help="chaos plan horizon in seconds (default: the "
+                             "whole --frames run)")
     parser.add_argument("--interactive", action="store_true",
                         help="read the local player's input from the "
                              "keyboard (W/A/S/D, raw-mode TTY) instead of "
@@ -116,6 +124,24 @@ def main() -> int:
     app = build_app(num_players, args.max_prediction, args.fps, input_fn,
                     speculation=args.speculate, metrics=inst.metrics)
     socket = UdpSocket.bind_to_port(args.local_port)
+    chaos = None
+    if args.chaos_seed is not None:
+        from bevy_ggrs_tpu.chaos import ChaosPlan, ChaosSocket
+
+        duration = args.chaos_duration
+        if duration is None:
+            duration = args.frames / args.fps
+        plan = ChaosPlan.generate(args.chaos_seed, duration)
+        # Plan times live on a zero-based epoch; the default clock
+        # (process uptime) would place every window in the past.
+        chaos_t0 = time.monotonic()
+        socket = chaos = ChaosSocket(
+            socket, plan, addr=("127.0.0.1", args.local_port),
+            clock=lambda: time.monotonic() - chaos_t0,
+        )
+        print(f"[chaos] seed={args.chaos_seed} "
+              f"directives={len(plan.directives)} "
+              f"horizon={plan.horizon():.1f}s")
     session = builder.start_p2p_session(socket)
     app.insert_session(session, SessionType.P2P)
     app.add_render_system(print_events_system)
@@ -157,6 +183,8 @@ def main() -> int:
                  f", spec_partial={app.stage.runner.spec_partial_hits}"
                  f", spec_misses={app.stage.runner.spec_misses}"
                  f", recovered={app.stage.runner.rollback_frames_recovered_total}")
+    if chaos is not None:
+        extra += f", chaos_faults={len(chaos.faults)}"
     print_world(app, f"p2p done after {app.frame} sim frames "
                      f"(rollbacks={app.stage.runner.rollbacks_total}, "
                      f"resimulated={app.stage.runner.rollback_frames_total}"

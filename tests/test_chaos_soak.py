@@ -1,0 +1,155 @@
+"""The acceptance soak: a 2000-frame three-peer match under a scripted
+chaos plan — loss bursts, reorder, duplication, corruption, one asymmetric
+partition window, and one peer kill/restart — with a supervisor on every
+peer. The match must converge with zero unrecovered desyncs and the
+survivors' confirmed frames bitwise identical.
+
+The plan is a fixed-seed :class:`ChaosPlan`, so a failure here replays
+exactly (tests/test_chaos.py proves two runs of one plan produce identical
+fault sequences)."""
+
+import pytest
+
+from bevy_ggrs_tpu.chaos import (
+    ChaosPlan,
+    ChaosSocket,
+    Corrupt,
+    Duplicate,
+    KillRestart,
+    LossBurst,
+    Partition,
+    Reorder,
+)
+from bevy_ggrs_tpu.session import SessionState
+from bevy_ggrs_tpu.session.supervisor import Health
+from bevy_ggrs_tpu.transport.loopback import LoopbackNetwork
+from tests.test_p2p import FPS_DT, scripted_input
+from tests.test_supervisor import make_supervised, settled_checksums, sup_step
+
+SOAK_PLAN = ChaosPlan(
+    2024,
+    (
+        LossBurst(2.0, 4.0, 0.2),
+        LossBurst(10.0, 12.0, 0.3),
+        LossBurst(20.0, 22.0, 0.25),
+        Reorder(5.0, 8.0, 0.2, delay=0.05),
+        Duplicate(6.0, 9.0, 0.3),
+        Corrupt(3.0, 12.0, 0.05),
+        Partition(14.0, 14.6, src=("peer", 1)),
+        KillRestart(24.0, ("peer", 2), 1.5),
+    ),
+)
+
+
+def wrap(net, peer, me):
+    session = peer[0]
+    session.socket = ChaosSocket(
+        session.socket, SOAK_PLAN, clock=lambda: net.now, addr=("peer", me)
+    )
+    return peer
+
+
+def run_soak(n_iters):
+    """Drive 3 supervised peers under SOAK_PLAN, executing its KillRestart
+    directives at the harness level (the socket can't kill a process)."""
+    net = LoopbackNetwork()
+    # The 0.6 s partition must outlast NETWORK_INTERRUPTED but stay under
+    # the disconnect timeout (a partition longer than the timeout IS a
+    # disconnect); the 1.5 s kill window must exceed it so the kill is
+    # detected and the reconnect path re-arms the address.
+    peers = {
+        me: wrap(net, make_supervised(net, 3, me, disconnect_timeout=1.0), me)
+        for me in range(3)
+    }
+    kills = [
+        {"at": kr.at, "until": kr.at + kr.down_for,
+         "me": kr.peer[1], "done": False, "killed": False}
+        for kr in SOAK_PLAN.kill_restarts()
+    ]
+    faults = []
+    restarted = set()
+    for _ in range(n_iters):
+        net.advance(FPS_DT)
+        for k in kills:
+            if not k["killed"] and net.now >= k["at"]:
+                victim = peers.pop(k["me"])
+                faults.extend(victim[0].socket.faults)
+                victim[0].socket.close()
+                k["killed"] = True
+            elif k["killed"] and not k["done"] and net.now >= k["until"]:
+                me = k["me"]
+                fresh = wrap(net, make_supervised(net, 3, me), me)
+                donor = ("peer", next(i for i in peers if i != me))
+                fresh[2].begin_rejoin(donor)
+                peers[me] = fresh
+                restarted.add(me)
+                k["done"] = True
+        for peer in peers.values():
+            sup_step(net, peer, scripted_input)
+    for peer in peers.values():
+        faults.extend(peer[0].socket.faults)
+    return peers, faults, restarted
+
+
+@pytest.mark.slow
+def test_three_peer_chaos_soak_2000_frames():
+    peers, faults, restarted = run_soak(2300)
+    assert restarted == {2}  # the KillRestart directive actually ran
+    sessions = [p[0] for p in peers.values()]
+    sups = [p[2] for p in peers.values()]
+    mets = [p[3] for p in peers.values()]
+
+    # Converged: every peer is running and past the 2000-frame mark.
+    for s in sessions:
+        assert s.current_state() == SessionState.RUNNING
+        assert s.current_frame >= 2000
+    assert min(s.confirmed_frame() for s in sessions) >= 2000
+
+    # Zero unrecovered desyncs: nobody is still quarantined/restoring and
+    # every quarantine that opened was closed by a recovery. A crash-rejoin
+    # is a recovery with no preceding quarantine, so >= not ==.
+    for sup, m in zip(sups, mets):
+        assert sup.health in (Health.HEALTHY, Health.DEGRADED)
+        assert m.counters["recoveries"] >= m.counters["quarantines"]
+    # The restarted peer actually came back through a state transfer.
+    restarted_m = peers[2][3]
+    assert restarted_m.counters["recoveries"] >= 1
+
+    # Bitwise-identical confirmed frames across the survivors, on settled
+    # exchange boundaries AFTER the last scheduled fault window.
+    horizon_frame = int(SOAK_PLAN.horizon() / FPS_DT)
+    frames, rows = settled_checksums(sessions)
+    tail = [(f, row) for f, row in zip(frames, rows) if f > horizon_frame]
+    assert len(tail) >= 3
+    for f, row in tail:
+        assert len(set(row)) == 1, f"frame {f} diverged: {row}"
+
+    # The plan actually injected chaos of every scripted kind.
+    kinds = {k for _, k, _ in faults}
+    assert {"loss", "reorder", "duplicate", "corrupt", "partition"} <= kinds
+    assert len(faults) > 50
+
+
+def test_two_peer_generated_plan_smoke():
+    """Non-slow CI guard: a generated plan (the --chaos-seed path) over a
+    short two-peer run still converges bitwise."""
+    net = LoopbackNetwork()
+    plan = ChaosPlan.generate(7, 3.0, (("peer", 0), ("peer", 1)))
+    peers = [make_supervised(net, 2, me) for me in range(2)]
+    for me, peer in enumerate(peers):
+        peer[0].socket = ChaosSocket(
+            peer[0].socket, plan, clock=lambda: net.now, addr=("peer", me)
+        )
+    for _ in range(300):
+        net.advance(FPS_DT)
+        for peer in peers:
+            sup_step(net, peer, scripted_input)
+    sessions = [p[0] for p in peers]
+    for s, _, sup, _ in peers:
+        assert s.current_state() == SessionState.RUNNING
+        assert sup.health in (Health.HEALTHY, Health.DEGRADED)
+    frames, rows = settled_checksums(sessions)
+    assert len(frames) >= 3
+    for f, row in zip(frames, rows):
+        assert row[0] == row[1], f"frame {f} diverged: {row}"
+    assert sum(len(p[0].socket.faults) for p in peers) > 0
