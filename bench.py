@@ -847,6 +847,13 @@ def _live_session_case(model: str, speculate: bool, transport: str) -> dict:
 
     peers = []
     metrics = Metrics()
+    # Peer 0 flies fully instrumented: the span tracer's per-phase summary
+    # and the flight recorder's rollback-depth histogram land as
+    # BENCH_DETAIL columns (attribution for the p99 the bench reports).
+    from bevy_ggrs_tpu.obs import FlightRecorder, SpanTracer
+
+    tracer = SpanTracer(process_name=f"live_{model}_{transport}")
+    recorder = FlightRecorder()
     for me in range(2):
         builder = (
             SessionBuilder(cfg["input_spec"])
@@ -858,13 +865,18 @@ def _live_session_case(model: str, speculate: bool, transport: str) -> dict:
                 builder.add_player(PlayerType.local(), h)
             else:
                 builder.add_player(PlayerType.remote(addr_of(1 - me)), h)
-        session = builder.start_p2p_session(socks[me], clock=clock)
+        session = builder.start_p2p_session(
+            socks[me], clock=clock,
+            metrics=metrics if me == 0 else None,
+            tracer=tracer if me == 0 else None,
+        )
         if me == 0 and speculate:
             runner = SpeculativeRollbackRunner(
                 cfg["schedule"](), cfg["world"](players),
                 max_prediction=max_prediction, num_players=players,
                 input_spec=cfg["input_spec"],
                 num_branches=cfg["branches"], metrics=metrics,
+                tracer=tracer,
             )
         else:
             runner = RollbackRunner(
@@ -872,6 +884,7 @@ def _live_session_case(model: str, speculate: bool, transport: str) -> dict:
                 max_prediction=max_prediction, num_players=players,
                 input_spec=cfg["input_spec"],
                 metrics=metrics if me == 0 else None,
+                tracer=tracer if me == 0 else None,
             )
         runner.warmup()
         peers.append((session, runner))
@@ -949,6 +962,9 @@ def _live_session_case(model: str, speculate: bool, transport: str) -> dict:
                     ready_rollback_ms.append(
                         (time.perf_counter() - t0) * 1000.0
                     )
+                # Flight-recorder capture sits OUTSIDE the timed region
+                # (ms is already banked) so the bench numbers stay clean.
+                recorder.capture(session=session, runner=runner)
         if paced:
             leftover = _DT - (time.perf_counter() - wall0)
             if leftover > 0:
@@ -966,6 +982,14 @@ def _live_session_case(model: str, speculate: bool, transport: str) -> dict:
         rtt_ms=-1.0,
         dispatch_floor_ms=round(dispatch_floor_ms, 3),
         confirmed_frames=int(session0.confirmed_frame()),
+        rollback_depth_histogram={
+            str(d): n for d, n in recorder.rollback_histogram().items()
+        },
+        span_summary={
+            name: {"count": s["count"], "mean_ms": round(s["mean_ms"], 4),
+                   "max_ms": round(s["max_ms"], 4)}
+            for name, s in sorted(tracer.summary().items())
+        },
         **_live_common_columns(
             metrics, runner0, executed_ticks, tick_ms, tick_sync,
             rollback_tick_ms, ready_rollback_ms, desync_events, paced,

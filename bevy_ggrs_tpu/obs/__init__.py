@@ -1,0 +1,44 @@
+"""Observability: flight recorder, span tracer, desync forensics, and
+Perfetto/Prometheus export. See docs/observability.md.
+
+Quick start::
+
+    from bevy_ggrs_tpu import obs
+
+    tracer = obs.SpanTracer(pid=0, process_name="peer-0")
+    recorder = obs.FlightRecorder()
+    session = builder.start_p2p_session(sock, metrics=metrics, tracer=tracer)
+    runner = RollbackRunner(..., metrics=metrics, tracer=tracer)
+    forensics = obs.DesyncForensics(session, runner, recorder, out_dir="obs/")
+
+    # drive loop:
+    session.poll_remote_clients()
+    forensics.scan(session.events())
+    runner.handle_requests(session.advance_frame(), session)
+    recorder.capture(session=session, runner=runner)
+
+    obs.export_perfetto(tracer, "trace.json")     # -> ui.perfetto.dev
+    obs.export_prometheus(metrics, recorder)      # -> text exposition
+"""
+
+from .forensics import DesyncForensics, desync_report
+from .prom import export_prometheus
+from .recorder import FlightRecorder, FrameRecord
+from .trace import SpanTracer, null_tracer
+
+
+def export_perfetto(tracer, path=None):
+    """Module-level convenience: Chrome-trace/Perfetto JSON for ``tracer``."""
+    return tracer.export_perfetto(path)
+
+
+__all__ = [
+    "DesyncForensics",
+    "FlightRecorder",
+    "FrameRecord",
+    "SpanTracer",
+    "desync_report",
+    "export_perfetto",
+    "export_prometheus",
+    "null_tracer",
+]

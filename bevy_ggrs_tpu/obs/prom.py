@@ -1,0 +1,78 @@
+"""Prometheus text-exposition snapshot fed from the existing ``Metrics``
+sink (plus, optionally, the flight recorder's rollback-depth histogram).
+
+This is a *snapshot* exporter — it renders the current state of a
+:class:`~bevy_ggrs_tpu.utils.metrics.Metrics` object as the text format a
+Prometheus scrape or a pushgateway upload expects. There is no HTTP
+server here on purpose: the drive loop owns the clock in this codebase
+(virtual-clock tests, pinned-core benches), so exposition is a pull the
+*caller* schedules, typically once per second or once at exit.
+
+Mapping:
+
+- counters  -> ``{ns}_{name}_total`` (counter) and ``{ns}_{name}_per_sec``
+  (gauge, the sink's lifetime rate);
+- series    -> a summary: ``{quantile="0.5|0.95|0.99"}`` samples plus
+  ``_count`` and ``_sum`` (reconstructed as mean*count);
+- recorder  -> ``{ns}_rollback_depth`` cumulative histogram buckets.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _sanitize(name: str) -> str:
+    clean = _NAME_RE.sub("_", name)
+    if clean and clean[0].isdigit():
+        clean = "_" + clean
+    return clean
+
+
+def _num(v) -> str:
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+def export_prometheus(
+    metrics,
+    recorder=None,
+    namespace: str = "ggrs",
+    path: Optional[str] = None,
+) -> str:
+    lines = []
+    for name, stats in sorted(metrics.summary().items()):
+        base = f"{namespace}_{_sanitize(name)}"
+        if "total" in stats:  # counter
+            lines.append(f"# TYPE {base}_total counter")
+            lines.append(f"{base}_total {_num(stats['total'])}")
+            lines.append(f"# TYPE {base}_per_sec gauge")
+            lines.append(f"{base}_per_sec {_num(stats['per_sec'])}")
+        else:  # series -> summary
+            count = stats["count"]
+            lines.append(f"# TYPE {base} summary")
+            for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+                lines.append(f'{base}{{quantile="{q}"}} {_num(stats[key])}')
+            lines.append(f"{base}_sum {_num(stats['mean'] * count)}")
+            lines.append(f"{base}_count {_num(count)}")
+    if recorder is not None:
+        hist = recorder.rollback_histogram()
+        base = f"{namespace}_rollback_depth"
+        lines.append(f"# TYPE {base} histogram")
+        cum = 0
+        total = 0.0
+        for depth in sorted(hist):
+            cum += hist[depth]
+            total += depth * hist[depth]
+            lines.append(f'{base}_bucket{{le="{depth}"}} {cum}')
+        lines.append(f'{base}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{base}_sum {_num(total)}")
+        lines.append(f"{base}_count {cum}")
+    text = "\n".join(lines) + "\n"
+    if path is not None:
+        with open(path, "w") as f:
+            f.write(text)
+    return text

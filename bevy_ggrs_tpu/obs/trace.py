@@ -1,0 +1,236 @@
+"""Span tracer: nested wall-clock phase spans over the session stage loop.
+
+The reference ships only `log`-crate warnings (survey §5: "no spans, no
+profiler hooks"); `utils.metrics` added counters and flat phase timers.
+This tracer adds the missing *timeline*: ``with trace.span("net_poll")``
+style nesting recorded as begin/end events on a monotonic microsecond
+clock, exported as
+
+- Chrome-trace / Perfetto JSON (:meth:`SpanTracer.export_perfetto` — load
+  the file in https://ui.perfetto.dev or ``chrome://tracing``),
+- a JSONL event stream (:meth:`SpanTracer.export_jsonl`),
+- a per-span-name aggregate (:meth:`SpanTracer.summary`, the per-phase
+  attribution BENCH rounds embed).
+
+Design notes:
+
+- Events are appended in runtime order, so begin/end matching and nesting
+  are correct *by construction*; export never has to re-derive a stack
+  from timestamps. The export pass only repairs the two edge cases a
+  bounded ring introduces (orphan ends whose begin was evicted, and spans
+  still open at export time, which are auto-closed at the final
+  timestamp).
+- The disabled path is the null-object pattern `utils.metrics` uses:
+  :data:`null_tracer` hands out one shared no-op span, so an instrumented
+  hot loop pays one attribute lookup + context enter/exit per span —
+  guarded under 2 % of a 500-frame loopback session by
+  ``tests/test_obs.py``.
+- Host-side only. For kernel-level profiles wrap the run with
+  ``jax.profiler.trace(logdir)``; both timelines compose (the XLA trace
+  carries device lanes, this one carries the session phases).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import time
+from typing import Dict, List, Optional
+
+# Event tuples: ("B", name, ts_us, args) / ("E", name, ts_us, None)
+#             / ("I", name, ts_us, args)   (instant)
+
+
+class _Span:
+    __slots__ = ("_tr", "_name", "_args", "_t0")
+
+    def __init__(self, tracer: "SpanTracer", name: str, args):
+        self._tr = tracer
+        self._name = name
+        self._args = args
+        self._t0 = 0
+
+    def __enter__(self):
+        tr = self._tr
+        self._t0 = tr._now_us()
+        tr._events.append(("B", self._name, self._t0, self._args))
+        tr._depth += 1
+        return self
+
+    def __exit__(self, *exc):
+        tr = self._tr
+        end = tr._now_us()
+        tr._events.append(("E", self._name, end, None))
+        tr._depth -= 1
+        dur = (end - self._t0) / 1000.0
+        agg = tr._agg.get(self._name)
+        if agg is None:
+            tr._agg[self._name] = [1, dur, dur]
+        else:
+            agg[0] += 1
+            agg[1] += dur
+            if dur > agg[2]:
+                agg[2] = dur
+        return False
+
+
+class SpanTracer:
+    """Enabled tracer. ``pid`` distinguishes peers when several tracers'
+    exports are merged into one trace (each peer is a Perfetto process)."""
+
+    enabled = True
+
+    def __init__(
+        self,
+        capacity: int = 200_000,
+        clock=time.perf_counter,
+        pid: int = 0,
+        tid: int = 0,
+        process_name: Optional[str] = None,
+    ):
+        self._clock = clock
+        self._origin = clock()
+        self._events = collections.deque(maxlen=int(capacity))
+        self._agg: Dict[str, List[float]] = {}  # name -> [count, total, max]
+        self._depth = 0
+        self.pid = int(pid)
+        self.tid = int(tid)
+        self.process_name = process_name
+
+    def _now_us(self) -> int:
+        return int((self._clock() - self._origin) * 1e6)
+
+    # -- instruments ----------------------------------------------------
+
+    def span(self, name: str, **args) -> _Span:
+        return _Span(self, name, args or None)
+
+    def instant(self, name: str, **args) -> None:
+        self._events.append(("I", name, self._now_us(), args or None))
+
+    # -- reporting ------------------------------------------------------
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-span-name {count, total_ms, mean_ms, max_ms}."""
+        return {
+            name: {
+                "count": int(c),
+                "total_ms": total,
+                "mean_ms": total / c if c else 0.0,
+                "max_ms": mx,
+            }
+            for name, (c, total, mx) in self._agg.items()
+        }
+
+    def _well_formed_events(self):
+        """Runtime events repaired to a provably matched, nested sequence:
+        begins always emit; an end emits only when it matches the top of
+        the reconstructed stack (an end whose begin was evicted from the
+        ring is dropped); spans still open at export time are closed at
+        the final timestamp, innermost first. Timestamps are monotonized
+        (the clock already is; this guards a caller-supplied clock)."""
+        out = []
+        stack: List[str] = []
+        last_ts = 0
+        for ph, name, ts, args in self._events:
+            if ts < last_ts:
+                ts = last_ts
+            last_ts = ts
+            if ph == "B":
+                stack.append(name)
+                out.append(("B", name, ts, args))
+            elif ph == "E":
+                if stack and stack[-1] == name:
+                    stack.pop()
+                    out.append(("E", name, ts, None))
+                # else: orphan end (begin evicted) — drop
+            else:
+                out.append(("I", name, ts, args))
+        for name in reversed(stack):
+            out.append(("E", name, last_ts, None))
+        return out
+
+    def export_perfetto(self, path: Optional[str] = None) -> dict:
+        """Chrome trace-event JSON (the format Perfetto's legacy importer
+        and ``chrome://tracing`` load). Returns the trace dict; also
+        writes it to ``path`` when given."""
+        events = []
+        if self.process_name is not None:
+            events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": self.pid,
+                    "tid": self.tid,
+                    "args": {"name": self.process_name},
+                }
+            )
+        for ph, name, ts, args in self._well_formed_events():
+            ev = {
+                "name": name,
+                "cat": "ggrs",
+                "ph": "i" if ph == "I" else ph,
+                "ts": ts,
+                "pid": self.pid,
+                "tid": self.tid,
+            }
+            if ph == "I":
+                ev["s"] = "t"  # thread-scoped instant
+            if args:
+                ev["args"] = dict(args)
+            events.append(ev)
+        trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(trace, f)
+        return trace
+
+    def export_jsonl(self, path: str) -> int:
+        """One JSON object per line, runtime order; returns lines written."""
+        n = 0
+        with open(path, "w") as f:
+            for ph, name, ts, args in self._well_formed_events():
+                rec = {"ph": ph, "name": name, "ts_us": ts}
+                if args:
+                    rec["args"] = dict(args)
+                f.write(json.dumps(rec) + "\n")
+                n += 1
+        return n
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _NullTracer:
+    """Shared no-op tracer: every instrument is O(1) and allocation-free
+    (mirrors ``utils.metrics.null_metrics``)."""
+
+    __slots__ = ()
+
+    enabled = False
+    _span = _NullSpan()
+
+    def span(self, name: str, **args) -> _NullSpan:
+        return self._span
+
+    def instant(self, name: str, **args) -> None:
+        pass
+
+    def summary(self):
+        return {}
+
+    def export_perfetto(self, path: Optional[str] = None) -> dict:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+
+    def export_jsonl(self, path: str) -> int:
+        return 0
+
+
+null_tracer = _NullTracer()

@@ -167,6 +167,7 @@ class SpeculativeExecutor:
         branch_axis: str = "branch",
         entity_axis: Optional[str] = None,
         state_template: Optional[WorldState] = None,
+        tracer=None,
     ):
         """With ``mesh`` alone, the branch axis is data-parallel across all
         devices. Adding ``entity_axis`` (+ a ``state_template`` for leaf
@@ -175,12 +176,15 @@ class SpeculativeExecutor:
         (boids all-pairs forces): annotate, and GSPMD inserts the
         gathers/reductions over ICI.
         """
+        from bevy_ggrs_tpu.obs.trace import null_tracer
+
         self.schedule = schedule
         self.num_branches = int(num_branches)
         self.max_frames = int(max_frames)
         self.mesh = mesh
         self.branch_axis = branch_axis
         self.entity_axis = entity_axis
+        self.tracer = tracer if tracer is not None else null_tracer
 
         run = functools.partial(self._run_impl, schedule, self.max_frames)
         commit = self._commit_impl
@@ -284,10 +288,11 @@ class SpeculativeExecutor:
         num_players = branch_bits.shape[2]
         if status is None:
             status = jnp.full((f, num_players), PREDICTED, dtype=jnp.int32)
-        rings, states, checksums = self._run(
-            state, jnp.asarray(start_frame, jnp.int32), branch_bits,
-            jnp.asarray(status, jnp.int32),
-        )
+        with self.tracer.span("spec_branch_dispatch", branches=b, frames=f):
+            rings, states, checksums = self._run(
+                state, jnp.asarray(start_frame, jnp.int32), branch_bits,
+                jnp.asarray(status, jnp.int32),
+            )
         return SpecResult(
             rings=rings,
             states=states,
@@ -301,10 +306,11 @@ class SpeculativeExecutor:
         """Gather branch ``branch``'s (ring, state) — the confirmed-branch
         select + scatter-back (survey §2.3). One collective gather when the
         branch axis is sharded."""
-        branch = jnp.asarray(branch, jnp.int32)
-        ring = self._commit(result.rings, branch)
-        state = self._commit(result.states, branch)
-        return ring, state
+        with self.tracer.span("spec_branch_commit"):
+            branch = jnp.asarray(branch, jnp.int32)
+            ring = self._commit(result.rings, branch)
+            state = self._commit(result.states, branch)
+            return ring, state
 
 
 def merge_rings(main: SnapshotRing, spec: SnapshotRing) -> SnapshotRing:

@@ -58,10 +58,13 @@ class RollbackRunner:
         metrics=None,
         mesh=None,
         entity_axis: str = "entity",
+        tracer=None,
     ):
+        from bevy_ggrs_tpu.obs.trace import null_tracer
         from bevy_ggrs_tpu.utils.metrics import null_metrics
 
         self.metrics = metrics if metrics is not None else null_metrics
+        self.tracer = tracer if tracer is not None else null_tracer
         self.schedule = schedule
         self.num_players = int(num_players)
         self.input_spec = input_spec
@@ -100,6 +103,10 @@ class RollbackRunner:
         (supervisor recovery) splits the list: everything before it executes
         first, then the restore replaces state/ring/frame, then execution
         resumes from the adopted frame."""
+        with self.tracer.span("handle_requests"):
+            self._handle_requests(requests, session)
+
+    def _handle_requests(self, requests: Sequence[object], session=None) -> None:
         batch: List[object] = []
         for req in requests:
             if isinstance(req, RestoreGameState):
@@ -179,7 +186,9 @@ class RollbackRunner:
             save_mask = np.array([s.save_frame is not None for s in steps])
             adv_mask = np.array([s.adv is not None for s in steps])
             self.device_dispatches_total += 1
-            with self.metrics.timer("dispatch"):
+            with self.metrics.timer("dispatch"), self.tracer.span(
+                "dispatch", frames=n
+            ):
                 self.ring, self.state, checksums = self.executor.run(
                     self.ring,
                     self.state,
@@ -204,7 +213,9 @@ class RollbackRunner:
                     if sf is not None and (wants is None or wants(sf))
                 ]
                 if report:
-                    with self.metrics.timer("checksum_sync"):
+                    with self.metrics.timer("checksum_sync"), self.tracer.span(
+                        "checksum_sync"
+                    ):
                         cs_host = np.asarray(checksums)  # [T, 2] lo/hi lanes
                     for t, sf in report:
                         session.report_checksum(sf, combine64(cs_host[t]))

@@ -157,7 +157,9 @@ class SessionBuilder:
         }
         return local, remote
 
-    def start_p2p_session(self, socket, clock=None) -> P2PSession:
+    def start_p2p_session(
+        self, socket, clock=None, metrics=None, tracer=None
+    ) -> P2PSession:
         local, remote = self._check_players()
         return P2PSession(
             num_players=self.num_players,
@@ -174,6 +176,8 @@ class SessionBuilder:
             seed=self.seed,
             clock=clock,
             desync_detection=self.desync_detection,
+            metrics=metrics,
+            tracer=tracer,
         )
 
     def start_synctest_session(self) -> SyncTestSession:

@@ -26,6 +26,7 @@ from bevy_ggrs_tpu.session.common import (
     SessionEvent,
     NULL_FRAME,
 )
+from bevy_ggrs_tpu.utils.metrics import null_metrics
 
 NUM_SYNC_ROUNDTRIPS = 5
 SYNC_RETRY_INTERVAL = 0.2
@@ -62,9 +63,11 @@ class PeerEndpoint:
         rng: np.random.RandomState,
         disconnect_timeout: float = DEFAULT_DISCONNECT_TIMEOUT,
         disconnect_notify_start: float = DEFAULT_DISCONNECT_NOTIFY_START,
+        metrics=None,
     ):
         self.addr = addr
         self.state = PeerState.SYNCHRONIZING
+        self.metrics = metrics if metrics is not None else null_metrics
         self._rng = rng
         self.disconnect_timeout = disconnect_timeout
         self.disconnect_notify_start = disconnect_notify_start
@@ -124,6 +127,7 @@ class PeerEndpoint:
 
     def _send(self, msg: proto.Message, now: float) -> None:
         data = proto.encode(msg)
+        self.metrics.count("datagrams_out")
         self.outbox.append(data)
         self.bytes_sent += len(data)
         self._send_window.append((now, len(data)))
@@ -236,6 +240,7 @@ class PeerEndpoint:
             if rtt >= 0:
                 self.ping_ms = 0.8 * self.ping_ms + 0.2 * rtt if self.ping_ms else rtt
         elif isinstance(msg, proto.ChecksumReport):
+            self.metrics.count("checksum_reports_rx")
             self.remote_checksums[msg.frame] = msg.checksum
             if len(self.remote_checksums) > 64:
                 for f in sorted(self.remote_checksums)[:-64]:
@@ -322,7 +327,9 @@ class PeerEndpoint:
             # its buffer would grow as long as the peer stays away. Keep
             # only the newest span's worth: a rejoiner that far behind
             # restores the older history from a state transfer anyway.
-            for f in sorted(pending)[: len(pending) - MAX_INPUT_SPAN]:
+            drop = sorted(pending)[: len(pending) - MAX_INPUT_SPAN]
+            self.metrics.count("input_queue_drops", len(drop))
+            for f in drop:
                 del pending[f]
 
     def refill_range(self, handle: int) -> Optional[Tuple[int, int]]:

@@ -54,6 +54,8 @@ from bevy_ggrs_tpu.native.core import (
 )
 from bevy_ggrs_tpu.session.endpoint import PeerEndpoint, PeerState
 from bevy_ggrs_tpu.session.requests import AdvanceFrame, LoadGameState, SaveGameState
+from bevy_ggrs_tpu.obs.trace import null_tracer
+from bevy_ggrs_tpu.utils.metrics import null_metrics
 
 # Upper bound on the AUTO desync-detection interval (frames between
 # checksum reports to peers). The effective default is
@@ -89,10 +91,14 @@ class P2PSession:
         seed: int = 0,
         clock=None,
         desync_detection="auto",
+        metrics=None,
+        tracer=None,
     ):
         self.num_players = int(num_players)
         self.input_spec = input_spec
         self.socket = socket
+        self.metrics = metrics if metrics is not None else null_metrics
+        self.tracer = tracer if tracer is not None else null_tracer
         self.max_prediction = int(max_prediction)
         # Desync-detection cadence: "auto" picks the largest interval that
         # still (usually) keeps the divergent frame inside the snapshot
@@ -139,6 +145,7 @@ class P2PSession:
                 rng,
                 disconnect_timeout=disconnect_timeout,
                 disconnect_notify_start=disconnect_notify_start,
+                metrics=self.metrics,
             )
         self._spectator_addrs = list(spectators)
         # Confirmed-input fan-out cursor per spectator address.
@@ -233,46 +240,56 @@ class P2PSession:
     # Network pump (`poll_remote_clients`, ggrs_stage.rs:113-119)
 
     def poll_remote_clients(self, now: Optional[float] = None) -> None:
+        with self.tracer.span("net_poll"):
+            self._poll_remote_clients(now)
+
+    def _poll_remote_clients(self, now: Optional[float] = None) -> None:
         now = self._clock() if now is None else now
-        for addr, data in self.socket.receive_all():
-            ep = self._endpoints.get(addr)
-            if ep is None:
-                continue  # unknown peer: drop (untrusted input)
-            msg = proto.decode(data)
-            if msg is None:
-                ep.note_undecodable(data)
-                continue
-            ep.on_message(
-                msg,
-                now,
-                lambda m, _addr=addr, _now=now: self._on_remote_inputs(
-                    _addr, m, _now
-                ),
-            )
+        datagrams_in = 0
+        with self.tracer.span("net_recv"):
+            for addr, data in self.socket.receive_all():
+                datagrams_in += 1
+                ep = self._endpoints.get(addr)
+                if ep is None:
+                    continue  # unknown peer: drop (untrusted input)
+                msg = proto.decode(data)
+                if msg is None:
+                    ep.note_undecodable(data)
+                    continue
+                ep.on_message(
+                    msg,
+                    now,
+                    lambda m, _addr=addr, _now=now: self._on_remote_inputs(
+                        _addr, m, _now
+                    ),
+                )
+        if datagrams_in:
+            self.metrics.count("datagrams_in", datagrams_in)
 
         self._check_desync()
         self._maybe_send_checksums(now)
 
         local_adv = self._local_advantage()
-        for addr, ep in self._endpoints.items():
-            before = ep.state
-            ep.poll(now, self.current_frame, local_adv)
-            if before != PeerState.DISCONNECTED and ep.state == PeerState.DISCONNECTED:
-                self._on_peer_disconnected(addr)
-            ack = self._ack_frame_for(addr)
-            ep.send_pending_inputs(now, self.current_frame, local_adv, ack)
-            if ep.control_inbox:
-                self._control_inbox.extend(
-                    (addr, m) for m in ep.control_inbox
-                )
-                ep.control_inbox.clear()
-                if len(self._control_inbox) > 256:
-                    del self._control_inbox[:-256]
-            self._events.extend(ep.events)
-            ep.events.clear()
-            for data in ep.outbox:
-                self.socket.send_to(data, addr)
-            ep.outbox.clear()
+        with self.tracer.span("net_send"):
+            for addr, ep in self._endpoints.items():
+                before = ep.state
+                ep.poll(now, self.current_frame, local_adv)
+                if before != PeerState.DISCONNECTED and ep.state == PeerState.DISCONNECTED:
+                    self._on_peer_disconnected(addr)
+                ack = self._ack_frame_for(addr)
+                ep.send_pending_inputs(now, self.current_frame, local_adv, ack)
+                if ep.control_inbox:
+                    self._control_inbox.extend(
+                        (addr, m) for m in ep.control_inbox
+                    )
+                    ep.control_inbox.clear()
+                    if len(self._control_inbox) > 256:
+                        del self._control_inbox[:-256]
+                self._events.extend(ep.events)
+                ep.events.clear()
+                for data in ep.outbox:
+                    self.socket.send_to(data, addr)
+                ep.outbox.clear()
 
         ahead = self.frames_ahead()
         if ahead > 0:
@@ -293,6 +310,7 @@ class P2PSession:
         """Send a state-transfer message directly (bypasses the endpoint
         outbox: recovery traffic must flow even to SYNCHRONIZING/quarantined
         peers the normal input path won't talk to)."""
+        self.metrics.count("datagrams_out")
         self.socket.send_to(proto.encode(msg), addr)
 
     def checksum_votes(self, frame: int, pop: bool = False) -> Dict[object, int]:
@@ -319,6 +337,7 @@ class P2PSession:
             self._rng,
             disconnect_timeout=self._disconnect_timeout,
             disconnect_notify_start=self._disconnect_notify_start,
+            metrics=self.metrics,
         )
         fresh.reconnecting = True
         self._endpoints[addr] = fresh
@@ -373,7 +392,9 @@ class P2PSession:
         ):
             if frame != queue.last_confirmed_frame + 1:
                 if frame <= queue.last_confirmed_frame:
+                    self.metrics.count("input_frames_redundant")
                     continue  # redundant resend
+                self.metrics.count("input_span_gaps")
                 break  # gap (loss beyond span) — wait for next resend
             queue.add_input(frame, bits)
             self._note_confirmed(h, frame, queue.confirmed(frame))
@@ -623,12 +644,17 @@ class P2PSession:
                 # 2-vs-1 desync is only decidable when the agreeing peer's
                 # vote is on file too.
                 self._checksum_votes.setdefault(frame, {})[ep.addr] = remote
+                self.metrics.count("checksum_ballots")
                 if (
                     local is not None
                     and local != remote
                     and frame not in self._desynced_frames
                 ):
                     self._desynced_frames.add(frame)
+                    self.metrics.count("desyncs_flagged")
+                    self.tracer.instant(
+                        "desync_detected", frame=frame, peer=str(ep.addr)
+                    )
                     self._events.append(
                         SessionEvent(
                             EventKind.DESYNC_DETECTED,
@@ -656,6 +682,10 @@ class P2PSession:
         ).reshape(self._zero.shape)
 
     def advance_frame(self) -> List[object]:
+        with self.tracer.span("advance_frame"):
+            return self._advance_frame()
+
+    def _advance_frame(self) -> List[object]:
         if self.current_state() != SessionState.RUNNING:
             raise NotSynchronized("session is still synchronizing")
         missing = [h for h in self.local_handles if h not in self._pending_local]
@@ -724,6 +754,8 @@ class P2PSession:
                 # residual divergence is exactly what desync detection +
                 # the supervisor's state resync repair.
                 rollback_to = floor
+            self.metrics.count("mispredictions")
+            self.metrics.observe("misprediction_depth", frame - rollback_to)
             requests.append(LoadGameState(rollback_to))
             for f in range(rollback_to, frame):
                 requests.append(SaveGameState(f))

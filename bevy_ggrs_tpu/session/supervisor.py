@@ -91,12 +91,19 @@ class SessionSupervisor:
         serve_state: bool = True,
         vote_timeout: float = 0.5,
         request_interval: float = 0.3,
+        tracer=None,
     ):
+        from bevy_ggrs_tpu.obs.trace import null_tracer
         from bevy_ggrs_tpu.utils.metrics import null_metrics
 
         self.session = session
         self.runner = runner
         self.metrics = metrics if metrics is not None else null_metrics
+        # Default to the session's tracer so one wiring point (the builder)
+        # instruments the whole stack; pass explicitly to split timelines.
+        if tracer is None:
+            tracer = getattr(session, "tracer", None)
+        self.tracer = tracer if tracer is not None else null_tracer
         self._clock = clock if clock is not None else session._clock
         self.reconnect = reconnect
         self.serve_state = serve_state
@@ -112,6 +119,16 @@ class SessionSupervisor:
         self._rejoin_donor = None
         self._freeze_until: Optional[int] = None
         self._frozen: Dict[int, np.ndarray] = {}
+
+    def _set_health(self, health: Health) -> None:
+        """All FSM transitions funnel through here so the trace timeline
+        carries every edge (the flight recorder additionally polls
+        ``self.health`` per capture)."""
+        if health is not self.health:
+            self.tracer.instant(
+                "health", prev=self.health.value, to=health.value
+            )
+        self.health = health
 
     # ------------------------------------------------------------------
     # Drive-loop surface
@@ -154,7 +171,7 @@ class SessionSupervisor:
         the donor starts accumulating our pending input spans BEFORE it
         serializes the checkpoint, so the adopted frontier has no gap."""
         self._rejoin_donor = donor_addr
-        self.health = Health.RESTORING
+        self._set_health(Health.RESTORING)
 
     # ------------------------------------------------------------------
 
@@ -162,6 +179,10 @@ class SessionSupervisor:
         """Pump recovery state; returns the session events drained this
         tick (plus the supervisor's own QUARANTINED/RECOVERED events) for
         the app to consume — call INSTEAD of ``session.events()``."""
+        with self.tracer.span("sup_tick"):
+            return self._tick(now)
+
+    def _tick(self, now: Optional[float] = None) -> List[SessionEvent]:
         now = self._clock() if now is None else now
         events = list(self.session.events())
         for ev in events:
@@ -187,9 +208,9 @@ class SessionSupervisor:
         self._drive_transfer(now, events)
 
         if self.health == Health.HEALTHY and self._interrupted:
-            self.health = Health.DEGRADED
+            self._set_health(Health.DEGRADED)
         elif self.health == Health.DEGRADED and not self._interrupted:
-            self.health = Health.HEALTHY
+            self._set_health(Health.HEALTHY)
         return events
 
     # ------------------------------------------------------------------
@@ -281,7 +302,7 @@ class SessionSupervisor:
             for a in [self._owner_of(h)]
             if a in winners and a != "local"
         )
-        self.health = Health.QUARANTINED
+        self._set_health(Health.QUARANTINED)
         self.metrics.count("quarantines")
         events.append(
             SessionEvent(
@@ -353,22 +374,23 @@ class SessionSupervisor:
 
     def _apply_transfer(self, now: float, events: List[SessionEvent]) -> None:
         t = self._transfer
-        data = b"".join(t["chunks"][s] for s in range(t["total"]))
-        try:
-            if t["kind"] == proto.STATE_KIND_RING:
-                self._adopt_ring(data, t, now)
-            else:
-                self._adopt_full(data, t, now)
-        except (ValueError, KeyError, InvalidRequest):
-            # Digest/template mismatch, or the replay needed inputs our
-            # queues no longer hold (donor frontier too far behind): retry
-            # under a fresh nonce — the donor's frontier advances, and we
-            # stay quarantined (not advancing) so a half-replayed runner is
-            # simply re-restored by the next successful transfer.
-            self._fail_transfer(now)
-            return
+        with self.tracer.span("sup_apply_transfer", kind=t["kind"]):
+            data = b"".join(t["chunks"][s] for s in range(t["total"]))
+            try:
+                if t["kind"] == proto.STATE_KIND_RING:
+                    self._adopt_ring(data, t, now)
+                else:
+                    self._adopt_full(data, t, now)
+            except (ValueError, KeyError, InvalidRequest):
+                # Digest/template mismatch, or the replay needed inputs our
+                # queues no longer hold (donor frontier too far behind): retry
+                # under a fresh nonce — the donor's frontier advances, and we
+                # stay quarantined (not advancing) so a half-replayed runner is
+                # simply re-restored by the next successful transfer.
+                self._fail_transfer(now)
+                return
         self._transfer = None
-        self.health = Health.HEALTHY
+        self._set_health(Health.HEALTHY)
         self.metrics.count("recoveries")
         self.metrics.observe(
             "recovery_latency_ms", (now - t["started"]) * 1000.0
@@ -471,7 +493,8 @@ class SessionSupervisor:
         key = (addr, req.nonce)
         chunks = self._served.get(key)
         if chunks is None:
-            built = self._build_payload(req.kind)
+            with self.tracer.span("sup_serve_state", kind=req.kind):
+                built = self._build_payload(req.kind)
             if built is None:
                 return  # nothing settled to serve yet; requester retries
             data, frame, digest = built

@@ -684,7 +684,7 @@ class SpeculativeRollbackRunner(RollbackRunner):
         self._spec = SpeculativeExecutor(
             schedule, self.num_branches, self.spec_frames,
             mesh=mesh, branch_axis=branch_axis, entity_axis=entity_axis,
-            state_template=self.state,
+            state_template=self.state, tracer=self.tracer,
         )
         # The fused whole-tick program (absorb + burst + rollout in one
         # dispatch) — the ONLY speculative-rollout executable live sessions
@@ -823,6 +823,10 @@ class SpeculativeRollbackRunner(RollbackRunner):
         of the next tick, by which time the producing program has
         completed in the frame's idle time — telemetry never blocks the
         tick critical path (the fallback paths keep synchronous reads)."""
+        with self.tracer.span("spec_tick"):
+            self._tick(requests, confirmed_frame, session)
+
+    def _tick(self, requests, confirmed_frame: int, session=None) -> None:
         self.ticks_total += 1
         self.flush_reports(session)
         if not self.speculation_enabled:
@@ -972,7 +976,9 @@ class SpeculativeRollbackRunner(RollbackRunner):
             if tail else np.zeros((0, self.num_players), np.int32)
         )
         self.device_dispatches_total += 1
-        with self.metrics.timer("tick_dispatch"):
+        with self.metrics.timer("tick_dispatch"), self.tracer.span(
+            "tick_dispatch"
+        ):
             (
                 self.ring, self.state, absorb_cs, burst_cs,
                 spec_rings, spec_states, spec_cs,
@@ -1127,7 +1133,9 @@ class SpeculativeRollbackRunner(RollbackRunner):
                 bits = self._structured_bits(
                     np.asarray(last), known, known_mask, anchor
                 )
-        with self.metrics.timer("speculate_dispatch"):
+        with self.metrics.timer("speculate_dispatch"), self.tracer.span(
+            "speculate_dispatch"
+        ):
             self._result = self._dispatch_rollout(anchor, bits)
 
     def _commit_full_hit(

@@ -94,12 +94,19 @@ class Metrics:
             out[name] = {"total": val, "per_sec": val / elapsed}
         return out
 
+    @staticmethod
+    def _fmt(v) -> str:
+        # Integral stats (count, whole-valued totals) read as integers;
+        # "count=123.000" is noise.
+        if isinstance(v, float):
+            return str(int(v)) if v.is_integer() else f"{v:.3f}"
+        return str(v)
+
     def report(self) -> str:
         lines = []
         for name, stats in sorted(self.summary().items()):
             body = " ".join(
-                f"{k}={v:.3f}" if isinstance(v, float) else f"{k}={v}"
-                for k, v in stats.items()
+                f"{k}={self._fmt(v)}" for k, v in stats.items()
             )
             lines.append(f"{name}: {body}")
         return "\n".join(lines)

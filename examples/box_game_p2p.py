@@ -66,6 +66,12 @@ def main() -> int:
     parser.add_argument("--chaos-duration", type=float, default=None,
                         help="chaos plan horizon in seconds (default: the "
                              "whole --frames run)")
+    parser.add_argument("--trace-dir", default=None, metavar="DIR",
+                        help="record spans + a frame-timeline flight "
+                             "recorder and write the artifacts "
+                             "(Perfetto trace.json, spans.jsonl, "
+                             "frames.jsonl, metrics.prom) into DIR at "
+                             "exit — bevy_ggrs_tpu.obs")
     parser.add_argument("--interactive", action="store_true",
                         help="read the local player's input from the "
                              "keyboard (W/A/S/D, raw-mode TTY) instead of "
@@ -101,6 +107,18 @@ def main() -> int:
     # Build (and JIT-compile) the app BEFORE binding the socket, so the
     # handshake starts only when we can actually service it.
     inst = Instruments(args)
+    tracer = recorder = None
+    if args.trace_dir:
+        from bevy_ggrs_tpu import obs
+        from bevy_ggrs_tpu.utils.metrics import Metrics
+
+        tracer = obs.SpanTracer(pid=args.local_port,
+                                process_name=f"peer:{args.local_port}")
+        recorder = obs.FlightRecorder()
+        if inst.metrics is None:
+            # The Prometheus snapshot needs a live sink even when
+            # --report-metrics is off.
+            inst.metrics = Metrics()
     keys = None
     input_fn = scripted_input
     if args.interactive:
@@ -142,8 +160,17 @@ def main() -> int:
         print(f"[chaos] seed={args.chaos_seed} "
               f"directives={len(plan.directives)} "
               f"horizon={plan.horizon():.1f}s")
-    session = builder.start_p2p_session(socket)
+    session = builder.start_p2p_session(socket, metrics=inst.metrics,
+                                        tracer=tracer)
     app.insert_session(session, SessionType.P2P)
+    if tracer is not None:
+        # One wiring point instruments the whole stack: the session was
+        # built with the tracer; the runner (and its speculative executor,
+        # if any) pick it up here.
+        app.stage.runner.tracer = tracer
+        spec = getattr(app.stage.runner, "_spec", None)
+        if spec is not None:
+            spec.tracer = tracer
     app.add_render_system(print_events_system)
     app.add_render_system(make_stats_system())
 
@@ -172,6 +199,8 @@ def main() -> int:
                 if keys.quit:
                     break
             app.update()
+            if recorder is not None:
+                recorder.capture(session=session, runner=app.stage.runner)
             if mgr is not None and session.current_state().name == "RUNNING":
                 mgr.maybe_save(app.stage.runner, session=session)
             lead = dt - (time.monotonic() - t0)
@@ -185,6 +214,16 @@ def main() -> int:
                  f", recovered={app.stage.runner.rollback_frames_recovered_total}")
     if chaos is not None:
         extra += f", chaos_faults={len(chaos.faults)}"
+    if args.trace_dir:
+        from bevy_ggrs_tpu import obs
+
+        os.makedirs(args.trace_dir, exist_ok=True)
+        obs.export_perfetto(tracer, os.path.join(args.trace_dir, "trace.json"))
+        tracer.export_jsonl(os.path.join(args.trace_dir, "spans.jsonl"))
+        recorder.export_jsonl(os.path.join(args.trace_dir, "frames.jsonl"))
+        obs.export_prometheus(inst.metrics, recorder,
+                              path=os.path.join(args.trace_dir, "metrics.prom"))
+        print(f"[obs] trace + flight-recorder artifacts in {args.trace_dir}/")
     print_world(app, f"p2p done after {app.frame} sim frames "
                      f"(rollbacks={app.stage.runner.rollbacks_total}, "
                      f"resimulated={app.stage.runner.rollback_frames_total}"

@@ -8,8 +8,11 @@ The plan is a fixed-seed :class:`ChaosPlan`, so a failure here replays
 exactly (tests/test_chaos.py proves two runs of one plan produce identical
 fault sequences)."""
 
+import os
+
 import pytest
 
+from bevy_ggrs_tpu.obs import FlightRecorder
 from bevy_ggrs_tpu.chaos import (
     ChaosPlan,
     ChaosSocket,
@@ -66,6 +69,11 @@ def run_soak(n_iters):
          "me": kr.peer[1], "done": False, "killed": False}
         for kr in SOAK_PLAN.kill_restarts()
     ]
+    # CI failure forensics: with GGRS_OBS_DIR set, a flight recorder rides
+    # along per peer and its frame timeline is dumped BEFORE the test's
+    # assertions run, so a failing soak still uploads the artifact.
+    obs_dir = os.environ.get("GGRS_OBS_DIR")
+    recorders = {me: FlightRecorder() for me in peers} if obs_dir else {}
     faults = []
     restarted = set()
     for _ in range(n_iters):
@@ -84,10 +92,19 @@ def run_soak(n_iters):
                 peers[me] = fresh
                 restarted.add(me)
                 k["done"] = True
-        for peer in peers.values():
+        for me, peer in peers.items():
             sup_step(net, peer, scripted_input)
+            if recorders:
+                recorders[me].capture(
+                    session=peer[0], runner=peer[1], supervisor=peer[2],
+                    now=net.now,
+                )
     for peer in peers.values():
         faults.extend(peer[0].socket.faults)
+    if obs_dir:
+        os.makedirs(obs_dir, exist_ok=True)
+        for me, rec in recorders.items():
+            rec.export_jsonl(os.path.join(obs_dir, f"soak_peer{me}_frames.jsonl"))
     return peers, faults, restarted
 
 

@@ -25,6 +25,37 @@ class TestInstruments:
             pass
         assert m.summary()["phase_ms"]["count"] == 1
 
+    def test_report_renders_integral_floats_without_decimals(self):
+        m = Metrics()
+        m.count("frames", 123)
+        m.observe("depth", 2.0)
+        m.observe("depth", 3.5)
+        rep = m.report()
+        frames_line = next(l for l in rep.splitlines() if l.startswith("frames"))
+        depth_line = next(l for l in rep.splitlines() if l.startswith("depth"))
+        # Integral stats read as integers, fractional keep 3 decimals.
+        assert "total=123 " in frames_line or frames_line.endswith("total=123")
+        assert "123.000" not in frames_line
+        assert "count=2" in depth_line
+        assert "mean=2.750" in depth_line
+        assert "max=3.500" in depth_line
+        # per_sec is genuinely fractional and keeps its decimals.
+        per_sec = m.summary()["frames"]["per_sec"]
+        if not float(per_sec).is_integer():
+            assert "per_sec=" in frames_line and "per_sec=123 " not in frames_line
+
+    def test_summary_shapes(self):
+        m = Metrics()
+        m.count("c", 2.5)  # fractional counter stays fractional
+        m.observe("s", 1.0)
+        s = m.summary()
+        assert set(s["s"]) == {"count", "mean", "p50", "p95", "p99", "max"}
+        assert set(s["c"]) == {"total", "per_sec"}
+        assert s["c"]["total"] == 2.5
+        assert Metrics._fmt(2.5) == "2.500"
+        assert Metrics._fmt(2.0) == "2"
+        assert Metrics._fmt(7) == "7"
+
     def test_null_metrics_noop(self):
         null_metrics.count("x")
         null_metrics.observe("y", 1.0)
