@@ -114,12 +114,29 @@ def match_branch(
     match (``depth == K``) means the session can reuse that branch's states
     outright; a partial match still skips ``depth`` frames of resimulation.
     Ties break toward branch 0 (the repeat-last baseline).
+
+    Byte-comparable (integer/bool) tensors take the native prefix matcher
+    (one ctypes call, no ``[B, K, …]`` comparison tensor); anything else —
+    or a core that didn't load — keeps the NumPy path. Both are
+    bitwise-identical (tests/test_native_spec.py).
     """
     bb = np.asarray(branch_bits)
     cb = np.asarray(confirmed_bits)
     k = cb.shape[0]
     if k == 0:
         return 0, 0
+    from bevy_ggrs_tpu.native import spec as native_spec
+
+    got = native_spec.match_prefix(bb, cb)
+    if got is not None:
+        return got
+    return _match_branch_numpy(bb, cb, k)
+
+
+def _match_branch_numpy(
+    bb: np.ndarray, cb: np.ndarray, k: int
+) -> Tuple[int, int]:
+    """Pure-NumPy :func:`match_branch` body (native-parity oracle)."""
     eq = bb[:, :k].reshape(bb.shape[0], k, -1) == cb.reshape(1, k, -1)
     frame_ok = eq.all(axis=2)  # [B, K]
     # Depth of agreement = leading run of True per branch.

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bevy_ggrs_tpu.fused import FusedTickExecutor, absorb_branch_frames
+from bevy_ggrs_tpu.native import spec as native_spec
 from bevy_ggrs_tpu.parallel.speculate import (
     SpecResult,
     SpeculativeExecutor,
@@ -703,7 +704,29 @@ class SpeculativeRollbackRunner(RollbackRunner):
         # inputs confirmed inside the span would re-dispatch an identical
         # rollout (the anchor state is ring-fixed once the frontier lags).
         self._spec_sig = None
-        self._input_log = {}  # as-used inputs, frame -> bits (host)
+        # Native branch-tree builder/matcher (session_core.cpp): the whole
+        # per-tick speculation host path — candidate ranking, periodic
+        # extrapolation, tensor assembly, dedup signature, branch match —
+        # in one ctypes call, bitwise-identical to the Python methods it
+        # bypasses (property-tested in tests/test_native_spec.py). None
+        # (pure-Python path) when the core doesn't load (GGRS_NO_NATIVE=1 /
+        # BEVY_GGRS_TPU_NATIVE=0), the dtype is outside the native
+        # contract, or a custom sampler replaces the structured tree.
+        self._native = (
+            native_spec.make_spec_builder(
+                input_spec, self.num_players, self.num_branches,
+                self.spec_frames, self._branch_values,
+            )
+            if sampler is None else None
+        )
+        # As-used inputs, frame -> bits (host). With the native builder the
+        # log is a dict SUBCLASS mirroring every mutation into the C++
+        # side, so the base runner's direct writes/deletes (and
+        # restore_state's truncation) keep both in sync automatically.
+        self._input_log = (
+            native_spec.MirroredLog(self._native)
+            if self._native is not None else {}
+        )
         # Deferred checksum reports: (device_cs_array, [(row, frame)]).
         # The fused tick never blocks on its own outputs — wanted
         # checksums are read at the START of the next tick, by which time
@@ -822,9 +845,19 @@ class SpeculativeRollbackRunner(RollbackRunner):
         wanted checksums queue as device arrays and are read at the start
         of the next tick, by which time the producing program has
         completed in the frame's idle time — telemetry never blocks the
-        tick critical path (the fallback paths keep synchronous reads)."""
+        tick critical path (the fallback paths keep synchronous reads).
+
+        The whole host-side tick is measured as ``spec_host_dispatch`` —
+        a SpanTracer span and a metrics timer (-> the
+        ``spec_host_dispatch_ms`` Prometheus summary), so host-dispatch
+        budget regressions show up in ``metrics.prom``/trace exports, not
+        just bench runs. Device work is asynchronous, so the interval is
+        pure orchestration cost: what the 1 ms budget gates."""
         with self.tracer.span("spec_tick"):
-            self._tick(requests, confirmed_frame, session)
+            with self.metrics.timer("spec_host_dispatch"), self.tracer.span(
+                "spec_host_dispatch"
+            ):
+                self._tick(requests, confirmed_frame, session)
 
     def _tick(self, requests, confirmed_frame: int, session=None) -> None:
         self.ticks_total += 1
@@ -875,21 +908,36 @@ class SpeculativeRollbackRunner(RollbackRunner):
             and res is not None
             and load_frame >= res.start_frame
         ):
-            needed = []
-            complete = True
-            for f in range(res.start_frame, load_frame):
-                got = self._input_log.get(f)
-                if got is None:
-                    complete = False
-                    break
-                needed.append(got)
-            if complete:
-                needed.extend(np.asarray(s.adv.bits) for s in steps)
-                needed_arr = np.stack(needed)[: res.num_frames]
+            matched = None
+            if self._native is not None:
+                # Native corrected-history match: the pre-span as-used
+                # inputs come from the builder's log mirror — no per-frame
+                # Python assembly. None = log gap (the Python
+                # complete=False), which charges no miss.
+                steps_arr = np.stack([np.asarray(s.adv.bits) for s in steps])
                 with self.metrics.timer("match_branch"):
-                    branch, depth = match_branch(
-                        np.asarray(res.branch_bits), needed_arr
+                    matched = self._native.match(
+                        np.asarray(res.branch_bits), res.start_frame,
+                        load_frame, steps_arr, res.num_frames,
                     )
+            else:
+                needed = []
+                complete = True
+                for f in range(res.start_frame, load_frame):
+                    got = self._input_log.get(f)
+                    if got is None:
+                        complete = False
+                        break
+                    needed.append(got)
+                if complete:
+                    needed.extend(np.asarray(s.adv.bits) for s in steps)
+                    needed_arr = np.stack(needed)[: res.num_frames]
+                    with self.metrics.timer("match_branch"):
+                        matched = match_branch(
+                            np.asarray(res.branch_bits), needed_arr
+                        )
+            if matched is not None:
+                branch, depth = matched
                 nc = min(depth - (load_frame - res.start_frame), n_steps)
                 if nc > 0:
                     absorb_branch, n_commit = int(branch), int(nc)
@@ -910,54 +958,88 @@ class SpeculativeRollbackRunner(RollbackRunner):
             )
             self._gc_log()
             return
-        last = self._input_log.get(anchor - 1)
-        if last is None:
-            last = self.input_spec.zeros_np(self.num_players)
-        with self.metrics.timer("known_inputs_query"):
-            known, known_mask = self._known_inputs(anchor, session)
-        if anchor < end and self._sampler is None:
-            sig = (
-                anchor, np.asarray(last).tobytes(),
-                known.tobytes(), known_mask.tobytes(),
-                self._history_fingerprint(anchor),
-            )
-            # Dedup-skip STEADY ticks only: a rollback tick already ran
-            # (and charged) the branch match above — delegating it to the
-            # legacy path would re-run the match and double-count
-            # spec_misses; re-dispatching its rollout fused is one
-            # dispatch either way.
-            if (
-                load_frame is None
+        if self._native is not None and self._sampler is None:
+            # One native call builds the dedup signature AND (unless the
+            # signature deduplicates the tick) the packed branch tensor —
+            # last/known/fingerprint/candidates all resolve inside the C++
+            # core. When the session's queue set is native too, the known
+            # inputs are read in-process and the known_inputs_query phase
+            # disappears from the tick entirely.
+            dedup = anchor < end
+            # Dedup-skip STEADY ticks only (see the Python path below).
+            allow_skip = (
+                dedup
+                and load_frame is None
                 and self._result is not None
-                and sig == self._spec_sig
-            ):
+                and self._spec_sig is not None
+            )
+            qs_ptr = self._native.qset_ptr(session)
+            if qs_ptr is not None:
+                known = known_mask = None
+            else:
+                with self.metrics.timer("known_inputs_query"):
+                    known, known_mask = self._known_inputs(anchor, session)
+            with self.metrics.timer("structured_bits_build"):
+                bits, sig = self._native.build(
+                    anchor, qs_ptr, known, known_mask, allow_skip,
+                    self._spec_sig,
+                )
+            if bits is None:
                 self.spec_dispatches_skipped += 1
                 self.metrics.count("spec_dispatches_skipped")
                 self.handle_requests(requests, session)
                 return
+            if not dedup:
+                sig = None
         else:
-            sig = None
+            last = self._input_log.get(anchor - 1)
+            if last is None:
+                last = self.input_spec.zeros_np(self.num_players)
+            with self.metrics.timer("known_inputs_query"):
+                known, known_mask = self._known_inputs(anchor, session)
+            if anchor < end and self._sampler is None:
+                sig = (
+                    anchor, np.asarray(last).tobytes(),
+                    known.tobytes(), known_mask.tobytes(),
+                    self._history_fingerprint(anchor),
+                )
+                # Dedup-skip STEADY ticks only: a rollback tick already ran
+                # (and charged) the branch match above — delegating it to
+                # the legacy path would re-run the match and double-count
+                # spec_misses; re-dispatching its rollout fused is one
+                # dispatch either way.
+                if (
+                    load_frame is None
+                    and self._result is not None
+                    and sig == self._spec_sig
+                ):
+                    self.spec_dispatches_skipped += 1
+                    self.metrics.count("spec_dispatches_skipped")
+                    self.handle_requests(requests, session)
+                    return
+            else:
+                sig = None
+            # The next rollout's branch tensor (host-side).
+            if self._sampler is not None:
+                self._key, sub = jax.random.split(self._key)
+                bits = enumerate_branches(
+                    sub, jnp.asarray(last), self.num_branches,
+                    self.spec_frames, sampler=self._sampler,
+                )
+                if known_mask.any():
+                    extra = bits.ndim - 3
+                    mask_b = jnp.asarray(known_mask).reshape(
+                        (1,) + known_mask.shape + (1,) * extra
+                    )
+                    bits = jnp.where(mask_b, jnp.asarray(known)[None], bits)
+                    base = _forward_fill(np.asarray(last), known, known_mask)
+                    bits = bits.at[0].set(jnp.asarray(base))
+            else:
+                with self.metrics.timer("structured_bits_build"):
+                    bits = self._structured_bits(
+                        np.asarray(last), known, known_mask, anchor
+                    )
         prev_r, prev_s = self._prev_buffers()
-        # The next rollout's branch tensor (host-side).
-        if self._sampler is not None:
-            self._key, sub = jax.random.split(self._key)
-            bits = enumerate_branches(
-                sub, jnp.asarray(last), self.num_branches, self.spec_frames,
-                sampler=self._sampler,
-            )
-            if known_mask.any():
-                extra = bits.ndim - 3
-                mask_b = jnp.asarray(known_mask).reshape(
-                    (1,) + known_mask.shape + (1,) * extra
-                )
-                bits = jnp.where(mask_b, jnp.asarray(known)[None], bits)
-                base = _forward_fill(np.asarray(last), known, known_mask)
-                bits = bits.at[0].set(jnp.asarray(base))
-        else:
-            with self.metrics.timer("structured_bits_build"):
-                bits = self._structured_bits(
-                    np.asarray(last), known, known_mask, anchor
-                )
         self._spec_sig = sig
         # Burst assembly: after a partial commit only the unmatched tail
         # resimulates, with no Load — the absorb phase positions the state.
@@ -1078,6 +1160,36 @@ class SpeculativeRollbackRunner(RollbackRunner):
             return
         if anchor <= self.frame - self.ring.depth:
             self._result = None  # anchor fell out of the ring
+            return
+        if self._native is not None and self._sampler is None:
+            # Native one-call build (see _tick): signature + branch tensor
+            # in one ctypes call, with the dedup-skip decided in-core.
+            dedup = anchor < self.frame
+            allow_skip = (
+                dedup
+                and self._result is not None
+                and self._spec_sig is not None
+            )
+            qs_ptr = self._native.qset_ptr(session)
+            if qs_ptr is not None:
+                known = known_mask = None
+            else:
+                with self.metrics.timer("known_inputs_query"):
+                    known, known_mask = self._known_inputs(anchor, session)
+            with self.metrics.timer("structured_bits_build"):
+                bits, sig = self._native.build(
+                    anchor, qs_ptr, known, known_mask, allow_skip,
+                    self._spec_sig,
+                )
+            if bits is None:
+                self.spec_dispatches_skipped += 1
+                self.metrics.count("spec_dispatches_skipped")
+                return
+            self._spec_sig = sig if dedup else None
+            with self.metrics.timer("speculate_dispatch"), self.tracer.span(
+                "speculate_dispatch"
+            ):
+                self._result = self._dispatch_rollout(anchor, bits)
             return
         last = self._input_log.get(anchor - 1)
         if last is None:
@@ -1542,15 +1654,27 @@ class SpeculativeRollbackRunner(RollbackRunner):
         # truncated to the rollout's span (frames past it can't be
         # committed and would shape-mismatch the branch tensor).
         pre = load_frame - anchor
-        needed = []
-        for f in range(anchor, load_frame):
-            got = self._input_log.get(f)
-            if got is None:
+        if self._native is not None:
+            steps_arr = np.stack([np.asarray(s.adv.bits) for s in steps])
+            matched = self._native.match(
+                np.asarray(res.branch_bits), anchor, load_frame, steps_arr,
+                res.num_frames,
+            )
+            if matched is None:  # log gap in the pre-span
                 return False
-            needed.append(got)
-        needed.extend(np.asarray(s.adv.bits) for s in steps)
-        needed_arr = np.stack(needed)[: res.num_frames]  # [k, P, ...]
-        branch, depth = match_branch(np.asarray(res.branch_bits), needed_arr)
+            branch, depth = matched
+        else:
+            needed = []
+            for f in range(anchor, load_frame):
+                got = self._input_log.get(f)
+                if got is None:
+                    return False
+                needed.append(got)
+            needed.extend(np.asarray(s.adv.bits) for s in steps)
+            needed_arr = np.stack(needed)[: res.num_frames]  # [k, P, ...]
+            branch, depth = match_branch(
+                np.asarray(res.branch_bits), needed_arr
+            )
         # Frames of the replay the best branch precomputed correctly.
         n_commit = min(depth - pre, n_steps)
         if n_commit <= 0:

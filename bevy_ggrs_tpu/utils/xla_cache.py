@@ -1,0 +1,57 @@
+"""Persistent XLA compilation cache — the product default for sessions.
+
+Cold start for a live session is dominated by XLA compiles: the fused tick
+program, the B-branch speculative rollout, and the warmup probes all
+compile from scratch in every fresh process. The persistent cache (keyed
+by HLO hash, so stale entries are impossible) turns every later process's
+cold start into a disk read; the bench matrix's process-isolated configs
+and a game relaunching on a player's machine hit the same path.
+
+:func:`ensure_persistent_compilation_cache` is called by
+``SessionBuilder`` on construction, making the cache a default every
+session gets rather than an env var only the test suite remembers to set.
+``GGRS_XLA_CACHE=0`` opts out; ``GGRS_XLA_CACHE_DIR`` overrides the
+location. An explicitly configured ``jax_compilation_cache_dir`` (env var,
+jax.config call, or this image's sitecustomize) always wins — the
+function is a no-op when one is already set.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_DEFAULT_DIR = "/tmp/bevy_ggrs_tpu_jax_cache"
+
+
+def ensure_persistent_compilation_cache(path: Optional[str] = None) -> Optional[str]:
+    """Enable JAX's persistent compilation cache if nothing configured one.
+
+    Returns the cache directory in effect, or ``None`` when caching is
+    disabled (``GGRS_XLA_CACHE=0``) or jax is unavailable/too old.
+    Exception-safe: a read-only filesystem or an unknown config flag must
+    never take a session down — the cache is an optimization, not a
+    dependency.
+    """
+    if os.environ.get("GGRS_XLA_CACHE", "").lower() in ("0", "false"):
+        return None
+    try:
+        import jax
+
+        current = jax.config.jax_compilation_cache_dir
+        if current:
+            return current  # explicit configuration wins
+        cache_dir = (
+            path
+            or os.environ.get("GGRS_XLA_CACHE_DIR")
+            or _DEFAULT_DIR
+        )
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # Session programs compile fast individually (the fused tick is
+        # one big program but the warmup probes are tiny) — cache them
+        # all, not just the ones above jax's default size/time floors.
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        return cache_dir
+    except Exception:
+        return None
