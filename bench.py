@@ -209,10 +209,10 @@ def _neural_bots_case(num_bots: int, players: int, frames: int, branches: int,
 
 
 def _boids_case(num_boids: int, players: int, frames: int, branches: int,
-                kernel: str):
+                kernel: str, mode: str = None):
     from bevy_ggrs_tpu.models import boids
 
-    return _spec_case(boids.make_schedule(kernel=kernel),
+    return _spec_case(boids.make_schedule(kernel=kernel, mode=mode),
                       boids.make_world(num_boids, players).commit(),
                       players, frames, branches, seed=4)
 
@@ -462,6 +462,21 @@ def _config_flop_model(name: str):
     wasted lanes."""
     import re
 
+    if name.startswith("boids") and name.endswith("_grid"):
+        from bevy_ggrs_tpu.models import boids
+
+        n = int(re.search(r"boids_(\d+)k", name).group(1)) * 1024
+        m = boids.grid_config(n).padded_cols
+        # Same 31 flops/pair as the dense model, but the candidate axis is
+        # the grid's padded 9K+S columns instead of all N — the O(N*k)
+        # work the spatial binning actually dispatches.
+        return n * m * 31, "vpu+mxu", (
+            f"31 flops/pair x N x padded_cols pairs (grid mode: "
+            f"9*cell_capacity + spill_capacity candidates per entity, "
+            f"padded to {m} lanes); counts dispatched candidate work, so "
+            f"mfu reflects lane padding but not the O(N^2) pairs the grid "
+            f"avoids"
+        )
     if name.startswith("boids"):
         n = int(re.search(r"boids_(\d+)k", name).group(1)) * 1024
         # Per pair: ~17 mask/weight VPU ops + 7 accumulator MACs (2 flops
@@ -530,6 +545,19 @@ def _measure_config(name: str, case, frames: int, branches: int) -> dict:
             extra["vpu_util_pct_est"] = round(
                 100.0 * gflops * vpu_frac / 1000.0 / _VPU_PEAK_TOPS_EST, 1
             )
+    if name.startswith("boids") and name.endswith("_grid"):
+        # Occupancy/spill columns: how full the grid's fixed-capacity cells
+        # are for THIS config's initial world — the numbers that say
+        # whether cell_capacity/spill_capacity were sized right (spill_rate
+        # ~0 and dropped == 0 are the health criteria; see
+        # docs/benchmarking.md).
+        from bevy_ggrs_tpu.models import boids
+        from bevy_ggrs_tpu.ops import neighbor as _neighbor
+
+        pos = state.components["position"]
+        active = (state.alive & state.present["position"]).astype(pos.dtype)
+        stats = _neighbor.grid_stats(pos, active, boids.grid_config(pos.shape[0]))
+        extra.update({f"grid_{k}": v for k, v in stats.items()})
     return _entry(
         name, device, frames, branches, rtt_ms=rtt,
         latency_ms=round(latency, 3),
@@ -573,6 +601,16 @@ _CONFIGS = {
     "boids_8k_8f_x_2b_mxu": (lambda: _boids_case(8192, 2, 8, 2, "mxu"), 8, 2),
     "boids_16k_8f_x_1b_mxu": (lambda: _boids_case(16384, 2, 8, 1, "mxu"), 8, 1),
     "boids_32k_8f_x_1b_mxu": (lambda: _boids_case(32768, 2, 8, 1, "mxu"), 8, 1),
+    # Spatial-binning neighbor grid (ops/neighbor.py): O(N*k) candidate
+    # work instead of O(N^2) pairs. The 32k grid entry is the budget
+    # carrier the dense path breaks (dense 32k mxu measured 28.3 ms); the
+    # 64k entry is a point the dense path cannot reach at all (a 64k^2
+    # pair matrix). kernel="pallas" runs the cell-gather Pallas kernel;
+    # occupancy/spill columns ride along (grid_* keys).
+    "boids_32k_8f_x_1b_grid": (
+        lambda: _boids_case(32768, 2, 8, 1, "pallas", mode="grid"), 8, 1),
+    "boids_64k_8f_x_1b_grid": (
+        lambda: _boids_case(65536, 2, 8, 1, "pallas", mode="grid"), 8, 1),
     # 5: depth × breadth stress — 8 players, 12 frames, 1024-branch tree.
     "box_game_8p_12f_x_1024b": (lambda: _box_game_case(8, 12, 1024), 12, 1024),
     # MXU model family: batched MLP inference inside the rollback domain

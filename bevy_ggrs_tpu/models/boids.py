@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bevy_ggrs_tpu.ops import neighbor
 from bevy_ggrs_tpu.schedule import InputSpec, PlayerInputs, Schedule
 from bevy_ggrs_tpu.state import HostWorld, TypeRegistry, WorldState
 
@@ -280,6 +281,104 @@ def pairwise_force_rows(
     return force * row_active[:, None]
 
 
+# ---------------------------------------------------------------------------
+# Grid mode: the same flocking rules over the spatial-binning neighbor grid
+# (ops/neighbor.py) — O(N·(9K+S)) instead of O(N²). Dense and grid modes are
+# allclose, not bitwise (different summation association); a session picks
+# one mode, and within grid mode serial/fused/sharded executables are
+# bitwise-equal to each other (tests/test_neighbor.py).
+# ---------------------------------------------------------------------------
+
+
+def _flock_accumulate(dx, dy, d2, row, col):
+    """Per-pair flocking terms, mask-for-mask identical to
+    :func:`pairwise_force_rows` (same f32 d² thresholds, same d≈0
+    self-exclusion — borderline pairs classify the same in both modes)."""
+    both = row["active"] * col["active"]
+    is_self = (d2 < jnp.float32(1e-10)).astype(jnp.float32)
+    neigh = (
+        both
+        * (d2 < jnp.float32(NEIGHBOR_RADIUS) ** 2).astype(jnp.float32)
+        * (1.0 - is_self)
+    )
+    inv_d = jax.lax.rsqrt(jnp.maximum(d2, jnp.float32(1e-12)))
+    close = neigh * (d2 < jnp.float32(SEPARATION_RADIUS) ** 2).astype(
+        jnp.float32
+    )
+    w = inv_d * close
+    return (
+        neigh,                 # neighbor count
+        dx * w, dy * w,        # separation (1/d-weighted push-away)
+        col["vx"] * neigh, col["vy"] * neigh,  # alignment sums
+        col["px"] * neigh, col["py"] * neigh,  # cohesion sums
+    )
+
+
+def _flock_combine(sums, row):
+    n, sx, sy, svx, svy, spx, spy = sums
+    n_safe = jnp.maximum(n, jnp.float32(1.0))
+    has = (n > 0).astype(jnp.float32)
+    fx = (
+        W_SEPARATION * sx
+        + W_ALIGNMENT * (svx / n_safe - row["vx"]) * has
+        + W_COHESION * (spx / n_safe - row["px"]) * has
+    )
+    fy = (
+        W_SEPARATION * sy
+        + W_ALIGNMENT * (svy / n_safe - row["vy"]) * has
+        + W_COHESION * (spy / n_safe - row["py"]) * has
+    )
+    return (fx * row["active"], fy * row["active"])
+
+
+FLOCK_PAIR_KERNEL = neighbor.PairKernel(
+    radius=float(NEIGHBOR_RADIUS),
+    out_dim=2,
+    n_terms=7,
+    accumulate=_flock_accumulate,
+    combine=_flock_combine,
+    row_feats=("vx", "vy"),
+    col_feats=("vx", "vy"),
+)
+
+
+def grid_config(num_boids: int) -> neighbor.GridConfig:
+    """The boids neighbor grid: cell edge = NEIGHBOR_RADIUS over the
+    ±WORLD_HALF torus (spawn-spiral positions beyond the torus just alias
+    mod G — false candidates the radius mask rejects)."""
+    return neighbor.default_grid_config(
+        num_boids, float(NEIGHBOR_RADIUS), float(WORLD_HALF)
+    )
+
+
+def _grid_forces(pos, vel, active, impl):
+    return neighbor.interact(
+        pos, active, FLOCK_PAIR_KERNEL,
+        feats={"vx": vel[:, 0], "vy": vel[:, 1]},
+        mode="grid", config=grid_config(pos.shape[0]), impl=impl,
+    )
+
+
+def flock_system_grid(state: WorldState, inputs: PlayerInputs) -> WorldState:
+    """`flock_system` over the neighbor grid, per-cell compute in XLA
+    (GSPMD-friendly; also the interpret-mode reference for the cell
+    kernel)."""
+    return _flock_step(
+        state, inputs, lambda p, v, a: _grid_forces(p, v, a, "xla")
+    )
+
+
+def flock_system_grid_pallas(
+    state: WorldState, inputs: PlayerInputs
+) -> WorldState:
+    """`flock_system` over the neighbor grid with the per-cell compute in
+    the Pallas cell-gather kernel (:mod:`bevy_ggrs_tpu.ops.cell_gather`) —
+    the single-chip 32k/64k path."""
+    return _flock_step(
+        state, inputs, lambda p, v, a: _grid_forces(p, v, a, "pallas")
+    )
+
+
 def increase_frame_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
     del inputs
     return state.replace(
@@ -291,7 +390,8 @@ def increase_frame_system(state: WorldState, inputs: PlayerInputs) -> WorldState
 
 
 def make_sharded_flock_system(mesh, entity_axis: str = "entity",
-                              kernel: str = "mxu"):
+                              kernel: str = "mxu",
+                              mode: Optional[str] = None):
     """A flock system whose Pallas kernel PARTITIONS over the mesh's entity
     axis via ``shard_map`` (round-2 verdict weak #7: GSPMD cannot partition
     a custom call, so under plain jit the Pallas kernels ran replicated —
@@ -323,24 +423,80 @@ def make_sharded_flock_system(mesh, entity_axis: str = "entity",
         all_a = jax.lax.all_gather(a, entity_axis, axis=0, tiled=True)
         return force_fn(p, v, all_p, all_v, a, all_a, **params)
 
-    sharded_force = jax.shard_map(
-        per_shard,
-        mesh=mesh,
-        in_specs=(P(entity_axis, None), P(entity_axis, None), P(entity_axis)),
-        out_specs=P(entity_axis, None),
-        check_vma=False,
-    )
+    n_shards = mesh.shape[entity_axis]
+
+    def per_shard_grid(p, v, a):
+        # Grid mode partitions by CELLS, not rows: every shard runs the
+        # identical replicated binning on the gathered set (bitwise-equal
+        # inputs -> bitwise-equal tables), computes slot forces for its
+        # contiguous cell slice, and all-gathers the slot-force tensor —
+        # an exact concatenation, so the scatter consumes bit-identical
+        # values to the unsharded path (a psum would not be: float
+        # reduction can re-associate). Spill + scatter are replicated.
+        all_p = jax.lax.all_gather(p, entity_axis, axis=0, tiled=True)
+        all_v = jax.lax.all_gather(v, entity_axis, axis=0, tiled=True)
+        all_a = jax.lax.all_gather(a, entity_axis, axis=0, tiled=True)
+        n = all_p.shape[0]
+        cfg = grid_config(n)
+        if cfg.num_cells % n_shards:
+            raise ValueError(
+                f"{cfg.num_cells} grid cells do not shard over "
+                f"{n_shards} devices"
+            )
+        grid, cand, padded = neighbor.build_grid_tables(
+            all_p, all_a, cfg,
+            feats={"vx": all_v[:, 0], "vy": all_v[:, 1]},
+        )
+        cells_per = cfg.num_cells // n_shards
+        idx = jax.lax.axis_index(entity_axis)
+        slots_sl = jax.lax.dynamic_slice_in_dim(
+            grid.slots, idx * cells_per, cells_per, 0
+        )
+        cand_sl = jax.lax.dynamic_slice_in_dim(
+            cand, idx * cells_per, cells_per, 0
+        )
+        slot_f = neighbor.slot_forces(
+            FLOCK_PAIR_KERNEL, slots_sl, cand_sl, padded
+        )
+        slot_full = jax.lax.all_gather(
+            slot_f, entity_axis, axis=0, tiled=True
+        )
+        spill_f = neighbor.spill_forces(FLOCK_PAIR_KERNEL, grid.spill, padded)
+        out = neighbor.scatter_forces(
+            n, grid.slots, grid.spill, slot_full, spill_f
+        )
+        return jax.lax.dynamic_slice_in_dim(out, idx * p.shape[0],
+                                            p.shape[0], 0)
+
+    def _shard(fn):
+        from bevy_ggrs_tpu.parallel.sharding import shard_map_compat
+
+        return shard_map_compat(
+            fn,
+            mesh=mesh,
+            in_specs=(
+                P(entity_axis, None), P(entity_axis, None), P(entity_axis)
+            ),
+            out_specs=P(entity_axis, None),
+        )
+
+    sharded_force = _shard(per_shard)
+    sharded_grid_force = _shard(per_shard_grid)
 
     def system(state: WorldState, inputs: PlayerInputs) -> WorldState:
-        return _flock_step(state, inputs, sharded_force)
+        n = state.components["position"].shape[0]
+        resolved = neighbor.resolve_mode(mode, n)
+        fn = sharded_grid_force if resolved == "grid" else sharded_force
+        return _flock_step(state, inputs, fn)
 
     return system
 
 
 def make_sharded_schedule(mesh, entity_axis: str = "entity",
-                          kernel: str = "mxu") -> Schedule:
+                          kernel: str = "mxu",
+                          mode: Optional[str] = None) -> Schedule:
     return Schedule([
-        make_sharded_flock_system(mesh, entity_axis, kernel),
+        make_sharded_flock_system(mesh, entity_axis, kernel, mode=mode),
         increase_frame_system,
     ])
 
@@ -352,10 +508,34 @@ _KERNELS = {
 }
 
 
-def make_schedule(use_pallas: bool = False, kernel: Optional[str] = None) -> Schedule:
+def make_schedule(use_pallas: bool = False, kernel: Optional[str] = None,
+                  mode: Optional[str] = None) -> Schedule:
     """``kernel``: "xla" (GSPMD-partitionable), "pallas" (VPU-tiled), or
-    "mxu" (matmul reductions — fastest single-chip). ``use_pallas`` is the
-    legacy bool for the first two."""
+    "mxu" (matmul reductions — fastest single-chip dense). ``use_pallas``
+    is the legacy bool for the first two.
+
+    ``mode`` selects the interaction structure: "dense" (the O(N²)
+    kernels above), "grid" (the O(N·k) neighbor grid — "pallas"/"mxu"
+    kernels route its per-cell compute through the cell-gather kernel,
+    "xla" stays pure XLA), or "auto" (grid at N >= neighbor grid
+    threshold). ``None`` keeps the legacy dense default. Resolution
+    happens at trace time via :func:`bevy_ggrs_tpu.ops.neighbor.
+    resolve_mode` — the ``GGRS_FORCE_MODE`` env var and the
+    ``SessionBuilder.with_interaction_mode`` session default override
+    ``None``/"auto" (never an explicit "dense"/"grid")."""
     if kernel is None:
         kernel = "pallas" if use_pallas else "xla"
-    return Schedule([_KERNELS[kernel], increase_frame_system])
+    dense_system = _KERNELS[kernel]
+    grid_system = (
+        flock_system_grid_pallas if kernel in ("pallas", "mxu")
+        else flock_system_grid
+    )
+
+    def flock(state: WorldState, inputs: PlayerInputs) -> WorldState:
+        n = state.components["position"].shape[0]
+        resolved = neighbor.resolve_mode(mode, n)
+        return (grid_system if resolved == "grid" else dense_system)(
+            state, inputs
+        )
+
+    return Schedule([flock, increase_frame_system])

@@ -46,6 +46,7 @@ from typing import Optional
 import jax.numpy as jnp
 import numpy as np
 
+from bevy_ggrs_tpu.ops import neighbor
 from bevy_ggrs_tpu.schedule import InputSpec, PlayerInputs, Schedule
 from bevy_ggrs_tpu.state import DEVICE_ID_BASE, HostWorld, TypeRegistry, WorldState
 
@@ -263,13 +264,51 @@ def fire_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
     )
 
 
-def projectile_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
+def _hit_accumulate(dx, dy, d2, row, col):
+    """Projectile-row vs turret-col hit indicator. Every factor is a 0/1
+    f32, so the candidate-axis sums are exact integers — dense and grid
+    modes agree BITWISE on the resulting hit booleans (unlike float force
+    sums, summation order cannot matter)."""
+    del dx, dy
+    return (
+        row["is_proj"]
+        * col["is_turret"]
+        * (row["owner"] != col["owner"]).astype(jnp.float32)
+        * (d2 < HIT_RADIUS * HIT_RADIUS).astype(jnp.float32),
+    )
+
+
+def _hit_combine(sums, row):
+    return (sums[0] * row["is_proj"],)
+
+
+HIT_PAIR_KERNEL = neighbor.PairKernel(
+    radius=float(HIT_RADIUS),
+    out_dim=1,
+    n_terms=1,
+    accumulate=_hit_accumulate,
+    combine=_hit_combine,
+    row_feats=("owner", "is_proj"),
+    col_feats=("owner", "is_turret"),
+)
+
+
+def projectile_system(
+    state: WorldState, inputs: PlayerInputs, *, mode: Optional[str] = None
+) -> WorldState:
     """Fly, age, collide, expire — entity DESTRUCTION inside the jitted step
     (the despawn side of ``world_snapshot.rs:190-193``).
 
     A projectile despawns when its ttl runs out, it leaves the arena, or it
     passes within ``HIT_RADIUS`` of an opposing turret (which scores its
     owner a point).
+
+    The hit test runs through :func:`bevy_ggrs_tpu.ops.neighbor.interact`
+    (``mode`` as in boids ``make_schedule``): the dense path reproduces
+    the original [cap, cap] broadcast bitwise, and because the interaction
+    terms are pure 0/1 indicators the grid path's hit booleans are bitwise
+    identical to dense too — the model's despawn/respawn machinery is
+    mode-invariant, which ``tests/test_neighbor.py`` checks step-for-step.
     """
     del inputs
     pos = state.components["position"]
@@ -285,15 +324,19 @@ def projectile_system(state: WorldState, inputs: PlayerInputs) -> WorldState:
     new_ttl = jnp.where(is_proj, ttl - 1, ttl)
 
     # Pairwise projectile-vs-turret hits on the moved positions.
-    diff = new_pos[:, None, :] - new_pos[None, :, :]  # [cap, cap, 2]
-    d2 = jnp.sum(diff * diff, axis=2)
-    hit = (
-        is_proj[:, None]
-        & is_turret[None, :]
-        & (owner[:, None] != owner[None, :])
-        & (d2 < HIT_RADIUS * HIT_RADIUS)
-    )  # [cap, cap]
-    proj_hit = jnp.any(hit, axis=1)
+    hit_count = neighbor.interact(
+        new_pos,
+        state.alive,
+        HIT_PAIR_KERNEL,
+        feats={
+            "owner": owner.astype(jnp.float32),
+            "is_proj": is_proj.astype(jnp.float32),
+            "is_turret": is_turret.astype(jnp.float32),
+        },
+        mode=mode,
+        world_half=float(ARENA_HALF),
+    )[:, 0]
+    proj_hit = hit_count > jnp.float32(0.0)
 
     # Score: one point per hit projectile to its owner (a projectile grazing
     # two turrets in the same frame still scores once).
@@ -337,11 +380,19 @@ def increase_frame_system(state: WorldState, inputs: PlayerInputs) -> WorldState
     )
 
 
-def make_schedule() -> Schedule:
+def make_schedule(mode: Optional[str] = None) -> Schedule:
+    """``mode``: interaction mode for the hit test ("dense" | "grid" |
+    "auto"; ``None`` = legacy dense unless ``GGRS_FORCE_MODE`` or the
+    SessionBuilder default overrides — see
+    :func:`bevy_ggrs_tpu.ops.neighbor.resolve_mode`)."""
+
+    def projectiles(state: WorldState, inputs: PlayerInputs) -> WorldState:
+        return projectile_system(state, inputs, mode=mode)
+
     return Schedule([
         move_turret_system,
         fire_system,
-        projectile_system,
+        projectiles,
         cooldown_system,
         increase_frame_system,
     ])

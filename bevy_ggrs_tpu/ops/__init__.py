@@ -16,10 +16,28 @@ mesh), selected automatically.
 """
 
 from bevy_ggrs_tpu.ops.checksum import checksum_pallas, install_pallas_checksum
+from bevy_ggrs_tpu.ops.neighbor import (
+    GridConfig,
+    PairKernel,
+    bin_entities,
+    default_grid_config,
+    grid_stats,
+    interact,
+    resolve_mode,
+    set_default_interaction_mode,
+)
 from bevy_ggrs_tpu.ops.pairwise import pairwise_force_rows_pallas
 
 __all__ = [
+    "GridConfig",
+    "PairKernel",
+    "bin_entities",
     "checksum_pallas",
+    "default_grid_config",
+    "grid_stats",
     "install_pallas_checksum",
+    "interact",
     "pairwise_force_rows_pallas",
+    "resolve_mode",
+    "set_default_interaction_mode",
 ]

@@ -70,6 +70,7 @@ class SessionBuilder:
         self.max_frames_behind = 4
         self.seed = 0
         self.desync_detection = "auto"
+        self.interaction_mode: Optional[str] = None
         self._players: Dict[int, PlayerType] = {}
         self._spectators: List[object] = []
 
@@ -114,6 +115,25 @@ class SessionBuilder:
 
     def with_seed(self, seed: int) -> "SessionBuilder":
         self.seed = int(seed)
+        return self
+
+    def with_interaction_mode(self, mode: Optional[str]) -> "SessionBuilder":
+        """Default pairwise-interaction mode for schedules built without an
+        explicit one: "dense" (O(N²) kernels), "grid" (the spatial-binning
+        neighbor grid, :mod:`bevy_ggrs_tpu.ops.neighbor`), or "auto" (grid
+        at N ≥ ``neighbor.GRID_AUTO_THRESHOLD``). ``None`` clears it.
+
+        Installs the process-wide trace-time default (see
+        ``neighbor.set_default_interaction_mode``): it applies to schedules
+        traced AFTER this call, sits below the ``GGRS_FORCE_MODE`` env
+        override, and never overrides a mode a model was given explicitly
+        (so pinned parity tests keep their pinned paths). Every executable
+        of one session resolves the same mode, which is what keeps serial,
+        fused-speculative and sharded ticks bitwise-equal."""
+        from bevy_ggrs_tpu.ops import neighbor
+
+        neighbor.set_default_interaction_mode(mode)
+        self.interaction_mode = mode
         return self
 
     def with_desync_detection(self, interval_frames) -> "SessionBuilder":

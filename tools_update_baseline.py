@@ -16,6 +16,8 @@ ROWS = [
     ("+", "8k boids, 8f × 2b (same pair count)", "boids_8k_8f_x_2b_mxu"),
     ("+", "16k boids, 8f × 1b (2× pairs)", "boids_16k_8f_x_1b_mxu"),
     ("+", "32k boids, 8f × 1b (8× pairs)", "boids_32k_8f_x_1b_mxu"),
+    ("+", "32k boids, 8f × 1b (neighbor grid)", "boids_32k_8f_x_1b_grid"),
+    ("+", "64k boids, 8f × 1b (neighbor grid)", "boids_64k_8f_x_1b_grid"),
     ("+", "neural_bots 512 (H=32, int8), 8f × 64b", "neural_bots_512_8f_x_64b"),
     ("+", "neural_bots H=256 (int8)", "neural_bots_512_h256_8f_x_64b"),
     ("+", "neural_bots H=512 (int8)", "neural_bots_512_h512_8f_x_64b"),
@@ -32,6 +34,11 @@ def main() -> None:
         e = by.get(key)
         if e is None:
             print(f"| {num} | {label} | MISSING | — | ❓ |")
+            continue
+        if "value" not in e:
+            # Wired-but-unmeasured entry (e.g. awaiting the TPU bench
+            # host); carries config/occupancy columns but no timing.
+            print(f"| {num} | {label} | pending | — | ⏳ |")
             continue
         v, r = e["value"], e["vs_baseline"]
         met = "✅" if r >= 1.0 else "❌"
