@@ -17,6 +17,7 @@ from bevy_ggrs_tpu.chaos.plan import (
     KillRestart,
     LossBurst,
     Partition,
+    RelayKillRestart,
     Reorder,
 )
 from bevy_ggrs_tpu.chaos.socket import ChaosSocket
@@ -29,5 +30,6 @@ __all__ = [
     "KillRestart",
     "LossBurst",
     "Partition",
+    "RelayKillRestart",
     "Reorder",
 ]

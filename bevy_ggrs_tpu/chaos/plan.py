@@ -85,7 +85,25 @@ class KillRestart:
     down_for: float
 
 
-Directive = Union[LossBurst, Reorder, Duplicate, Corrupt, Partition, KillRestart]
+@dataclasses.dataclass(frozen=True)
+class RelayKillRestart:
+    """Script a RELAY-process death: the relay at address ``relay`` goes
+    down at ``at`` and may be restarted ``down_for`` seconds later. Like
+    :class:`KillRestart`, the socket layer ignores it — the harness closes
+    the relay's socket and rebuilds it after the window (with a FRESH
+    epoch, so publishers re-seed the stream buffer; see
+    tests/test_relay.py). Carrying it in the plan makes relay failover
+    replayable under a fixed seed, same as peer kill/restarts."""
+
+    at: float
+    relay: object
+    down_for: float
+
+
+Directive = Union[
+    LossBurst, Reorder, Duplicate, Corrupt, Partition, KillRestart,
+    RelayKillRestart,
+]
 
 _KINDS = {
     "loss": LossBurst,
@@ -94,6 +112,7 @@ _KINDS = {
     "corrupt": Corrupt,
     "partition": Partition,
     "kill_restart": KillRestart,
+    "relay_kill_restart": RelayKillRestart,
 }
 _NAMES = {cls: name for name, cls in _KINDS.items()}
 
@@ -142,11 +161,22 @@ class ChaosPlan:
             key=lambda d: d.at,
         )
 
+    def relay_kill_restarts(self) -> List[RelayKillRestart]:
+        return sorted(
+            (d for d in self.directives if isinstance(d, RelayKillRestart)),
+            key=lambda d: d.at,
+        )
+
     def horizon(self) -> float:
         """Time at which the last directive has expired/healed."""
         t = 0.0
         for d in self.directives:
-            t = max(t, d.at + d.down_for if isinstance(d, KillRestart) else d.end)
+            t = max(
+                t,
+                d.at + d.down_for
+                if isinstance(d, (KillRestart, RelayKillRestart))
+                else d.end,
+            )
         return t
 
     # -- (de)serialization: the replay artifact --------------------------
@@ -158,7 +188,7 @@ class ChaosPlan:
             for f in dataclasses.fields(d):
                 v = getattr(d, f.name)
                 entry[f.name] = _addr_to_json(v) if f.name in (
-                    "src", "dst", "peer"
+                    "src", "dst", "peer", "relay"
                 ) else v
             out.append(entry)
         return json.dumps({"seed": self.seed, "directives": out}, indent=2)
@@ -170,7 +200,7 @@ class ChaosPlan:
         for entry in raw["directives"]:
             entry = dict(entry)
             kind = _KINDS[entry.pop("kind")]
-            for k in ("src", "dst", "peer"):
+            for k in ("src", "dst", "peer", "relay"):
                 if k in entry:
                     entry[k] = _addr_from_json(entry[k])
             directives.append(kind(**entry))
@@ -185,12 +215,14 @@ class ChaosPlan:
         duration: float,
         peers: Tuple[object, ...] = (),
         kill_restart: bool = False,
+        relay: Optional[object] = None,
     ) -> "ChaosPlan":
         """A deterministic mixed-fault schedule over ``duration`` seconds:
         a few loss bursts, one reorder window, one duplication window, one
         light corruption window, one asymmetric partition with a heal
-        window, and (opt-in) one peer kill/restart. Same ``(seed, duration,
-        peers)`` -> same plan, always."""
+        window, (opt-in) one peer kill/restart, and — when ``relay`` names
+        a relay address — one scripted relay kill/restart. Same ``(seed,
+        duration, peers, relay)`` -> same plan, always."""
         rng = np.random.RandomState(seed & 0x7FFFFFFF)
         span = max(float(duration), 1.0)
         d: List[Directive] = []
@@ -216,4 +248,8 @@ class ChaosPlan:
                 t0 = float(rng.uniform(0.6 * span, 0.8 * span))
                 d.append(KillRestart(t0, victim,
                                      float(rng.uniform(0.05, 0.1) * span)))
+        if relay is not None:
+            t0 = float(rng.uniform(0.3 * span, 0.55 * span))
+            d.append(RelayKillRestart(t0, relay,
+                                      float(rng.uniform(0.03, 0.06) * span)))
         return cls(seed, tuple(d))

@@ -1,0 +1,48 @@
+"""Relay transport tier + broadcast spectator fan-out.
+
+The reference's networking is strictly peer-to-peer and its spectator
+flavor strictly 1:1. This package adds the delivery tier production needs
+(ROADMAP: "one match watched by 100k spectators"):
+
+- :class:`~bevy_ggrs_tpu.relay.server.RelayServer` — terminates peer
+  traffic (NAT-friendly: everyone dials the relay) by forwarding opaque
+  wire datagrams between registered peers, and fans the confirmed-state
+  stream out to subscribers under per-subscriber flow control with a
+  graceful degradation ladder (full deltas → keyframe-only → shed with a
+  resumable cursor).
+- :class:`~bevy_ggrs_tpu.relay.client.RelaySocket` — a
+  ``NonBlockingSocket`` giving sessions stable *logical* peer addresses
+  through the relay, with transparent failover to standby relays.
+- :class:`~bevy_ggrs_tpu.relay.delta.StateCodec` + XOR/RLE delta codec —
+  exact (bitwise) confirmed-state deltas; confirmed frames are
+  bitwise-stable, so the stream needs no tolerance anywhere.
+- :class:`~bevy_ggrs_tpu.relay.stream.StatePublisher` /
+  :class:`~bevy_ggrs_tpu.relay.stream.StreamSpectator` — the host-side
+  uploader (one stream up, N streams out) and the broadcast spectator
+  that reconstructs every confirmed frame bitwise.
+
+Contracts and the chaos-soak story live in docs/relay.md.
+"""
+
+from bevy_ggrs_tpu.relay.client import RELAY_CONTROL, RelaySocket, peer_addr
+from bevy_ggrs_tpu.relay.delta import (
+    StateCodec,
+    delta_apply,
+    delta_encode,
+    payload_digest,
+)
+from bevy_ggrs_tpu.relay.server import RelayServer
+from bevy_ggrs_tpu.relay.stream import StatePublisher, StreamSpectator
+
+__all__ = [
+    "RELAY_CONTROL",
+    "RelayServer",
+    "RelaySocket",
+    "StateCodec",
+    "StatePublisher",
+    "StreamSpectator",
+    "delta_apply",
+    "delta_encode",
+    "payload_digest",
+    "peer_addr",
+]

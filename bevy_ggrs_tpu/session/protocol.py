@@ -46,6 +46,19 @@ T_CHECKSUM_REPORT = 8
 # the datagram, so mixed deployments degrade to "no recovery", not desync.
 T_STATE_REQUEST = 9
 T_STATE_CHUNK = 10
+# Relay tier (bevy_ggrs_tpu/relay/): peer registration + forwarding envelope
+# so NAT'd peers exchange the types above THROUGH a RelayServer (the
+# forwarded payload is a complete inner datagram, types 1-10 included — the
+# relay never parses it), plus the broadcast spectator stream: subscribe /
+# delta / keyframe / ack. Same no-version-bump rule: a relay-less peer drops
+# these unknown type bytes and keeps playing direct.
+T_RELAY_HELLO = 11
+T_RELAY_WELCOME = 12
+T_RELAY_FORWARD = 13
+T_SUBSCRIBE = 14
+T_STREAM_DELTA = 15
+T_STREAM_KEYFRAME = 16
+T_STREAM_ACK = 17
 
 # StateRequest.kind values.
 STATE_KIND_RING = 0  # world snapshot at one settled frame (desync resync)
@@ -158,9 +171,99 @@ class StateChunk:
     payload: bytes
 
 
+@dataclasses.dataclass(frozen=True)
+class RelayHello:
+    """Register (and keep alive) the sender's address at a relay as
+    ``(session_id, peer_id)``. Sent periodically — it doubles as the NAT
+    keepalive and the relay-liveness probe: every hello is answered by a
+    :class:`RelayWelcome`, and a client that stops seeing welcomes fails
+    over to its standby relay (relay/client.py)."""
+
+    session_id: int
+    peer_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class RelayWelcome:
+    """Hello ack. ``epoch`` identifies the relay *instance*: a restarted
+    (or standby) relay carries a different epoch, which tells publishers
+    their delta chain's base is gone relay-side and a fresh keyframe must
+    re-seed the stream buffer."""
+
+    session_id: int
+    peer_id: int
+    epoch: int
+
+
+@dataclasses.dataclass(frozen=True)
+class RelayForward:
+    """The forwarding envelope. Client→relay: ``dst`` names the target
+    peer_id, ``src`` must match the sender's registration (spoofed srcs are
+    dropped). Relay→client: ``src`` preserved, and the receiver surfaces
+    ``payload`` as one inner datagram from the *logical* address
+    ``("relay-peer", src)`` — sessions never learn real peer addresses, so
+    relay failover changes no endpoint key."""
+
+    src: int
+    dst: int
+    payload: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class Subscribe:
+    """Spectator→relay: join (or resume) the confirmed-state stream.
+    ``cursor`` is the last frame the spectator holds reconstructed
+    (NULL_FRAME/-1 for a cold join → the relay starts from its newest
+    keyframe); ``window`` is the spectator's receive budget in frames — the
+    relay never sends deltas more than ``window`` frames past the last
+    ack (explicit backpressure)."""
+
+    session_id: int
+    cursor: int
+    window: int
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamDelta:
+    """One confirmed frame as an XOR+RLE delta against the previously
+    published frame ``base_frame`` (exact — confirmed frames are
+    bitwise-stable). ``crc`` is crc32 of the RECONSTRUCTED full state
+    bytes, so a corrupted delta is rejected after apply, not trusted."""
+
+    frame: int
+    base_frame: int
+    crc: int
+    payload: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamKeyframe:
+    """One fragment of a full confirmed-state snapshot (chunked like
+    :class:`StateChunk`). ``crc`` guards this fragment's bytes; ``digest``
+    is the 64-bit digest of the whole reassembled state payload."""
+
+    frame: int
+    seq: int
+    total: int
+    crc: int
+    digest: int
+    payload: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamAck:
+    """Spectator→relay flow control: ``frame`` is the highest frame the
+    spectator has RECONSTRUCTED (contiguously applied), not merely
+    received — the relay's send window advances only on real progress."""
+
+    frame: int
+
+
 Message = Union[
     SyncRequest, SyncReply, InputMsg, InputAck, QualityReport, QualityReply,
     KeepAlive, ChecksumReport, StateRequest, StateChunk,
+    RelayHello, RelayWelcome, RelayForward, Subscribe,
+    StreamDelta, StreamKeyframe, StreamAck,
 ]
 
 _U32 = struct.Struct("<I")
@@ -169,6 +272,13 @@ _BI = struct.Struct("<Bi")
 _IH = struct.Struct("<Ih")
 _STATE_REQ = struct.Struct("<IBi")  # nonce, kind, resend_from
 _STATE_CHUNK = struct.Struct("<IBiQHHI")  # nonce kind frame checksum seq total crc
+_RELAY_HELLO = struct.Struct("<IH")  # session_id, peer_id
+_RELAY_WELCOME = struct.Struct("<IHI")  # session_id, peer_id, epoch
+_RELAY_FWD = struct.Struct("<HH")  # src, dst
+_SUBSCRIBE = struct.Struct("<IiH")  # session_id, cursor, window
+_STREAM_DELTA = struct.Struct("<iiI")  # frame, base_frame, crc
+_STREAM_KF = struct.Struct("<iHHIQ")  # frame, seq, total, crc, digest
+_I32 = struct.Struct("<i")
 
 
 def encode(msg: Message) -> bytes:
@@ -214,6 +324,42 @@ def encode(msg: Message) -> bytes:
             )
             + msg.payload
         )
+    if isinstance(msg, RelayHello):
+        return _HDR.pack(MAGIC, VERSION, T_RELAY_HELLO) + _RELAY_HELLO.pack(
+            msg.session_id & 0xFFFFFFFF, msg.peer_id & 0xFFFF
+        )
+    if isinstance(msg, RelayWelcome):
+        return _HDR.pack(MAGIC, VERSION, T_RELAY_WELCOME) + _RELAY_WELCOME.pack(
+            msg.session_id & 0xFFFFFFFF, msg.peer_id & 0xFFFF,
+            msg.epoch & 0xFFFFFFFF,
+        )
+    if isinstance(msg, RelayForward):
+        return (
+            _HDR.pack(MAGIC, VERSION, T_RELAY_FORWARD)
+            + _RELAY_FWD.pack(msg.src & 0xFFFF, msg.dst & 0xFFFF)
+            + msg.payload
+        )
+    if isinstance(msg, Subscribe):
+        return _HDR.pack(MAGIC, VERSION, T_SUBSCRIBE) + _SUBSCRIBE.pack(
+            msg.session_id & 0xFFFFFFFF, msg.cursor, msg.window & 0xFFFF
+        )
+    if isinstance(msg, StreamDelta):
+        return (
+            _HDR.pack(MAGIC, VERSION, T_STREAM_DELTA)
+            + _STREAM_DELTA.pack(msg.frame, msg.base_frame, msg.crc & 0xFFFFFFFF)
+            + msg.payload
+        )
+    if isinstance(msg, StreamKeyframe):
+        return (
+            _HDR.pack(MAGIC, VERSION, T_STREAM_KEYFRAME)
+            + _STREAM_KF.pack(
+                msg.frame, msg.seq, msg.total,
+                msg.crc & 0xFFFFFFFF, msg.digest & 0xFFFFFFFFFFFFFFFF,
+            )
+            + msg.payload
+        )
+    if isinstance(msg, StreamAck):
+        return _HDR.pack(MAGIC, VERSION, T_STREAM_ACK) + _I32.pack(msg.frame)
     raise TypeError(f"unknown message {msg!r}")
 
 
@@ -269,6 +415,28 @@ def decode(data: bytes) -> Optional[Message]:
             return StateChunk(
                 nonce, kind, frame, cs, seq, total, crc, body[_STATE_CHUNK.size :]
             )
+        if mtype == T_RELAY_HELLO:
+            sid, pid = _RELAY_HELLO.unpack_from(body)
+            return RelayHello(sid, pid)
+        if mtype == T_RELAY_WELCOME:
+            sid, pid, epoch = _RELAY_WELCOME.unpack_from(body)
+            return RelayWelcome(sid, pid, epoch)
+        if mtype == T_RELAY_FORWARD:
+            src, dst = _RELAY_FWD.unpack_from(body)
+            return RelayForward(src, dst, body[_RELAY_FWD.size :])
+        if mtype == T_SUBSCRIBE:
+            sid, cursor, window = _SUBSCRIBE.unpack_from(body)
+            return Subscribe(sid, cursor, window)
+        if mtype == T_STREAM_DELTA:
+            frame, base, crc = _STREAM_DELTA.unpack_from(body)
+            return StreamDelta(frame, base, crc, body[_STREAM_DELTA.size :])
+        if mtype == T_STREAM_KEYFRAME:
+            frame, seq, total, crc, digest = _STREAM_KF.unpack_from(body)
+            return StreamKeyframe(
+                frame, seq, total, crc, digest, body[_STREAM_KF.size :]
+            )
+        if mtype == T_STREAM_ACK:
+            return StreamAck(_I32.unpack_from(body)[0])
         return None
     except struct.error:
         return None

@@ -35,6 +35,15 @@ from bevy_ggrs_tpu.native.core import make_queue_set
 from bevy_ggrs_tpu.session.endpoint import PeerEndpoint, PeerState
 from bevy_ggrs_tpu.session.requests import AdvanceFrame
 
+# Hard per-call burst cap on catch-up, independent of configuration: one
+# ``advance_frame()`` never emits more than this many advances even when a
+# caller sets ``max_frames_behind`` huge or a spectator resumes hundreds of
+# frames behind (long partition / checkpoint resume). The host loop driving
+# the spectator therefore has bounded per-poll work — a returning spectator
+# converges over several polls instead of stalling one poll for an
+# unbounded dispatch burst.
+CATCHUP_BURST_CAP = 16
+
 
 class SpectatorSession:
     def __init__(
@@ -213,7 +222,7 @@ class SpectatorSession:
         behind = confirmed - self.current_frame + 1
         n = 1
         if behind > self.catchup_threshold:
-            n = min(behind, self.max_frames_behind)
+            n = min(behind, self.max_frames_behind, CATCHUP_BURST_CAP)
         requests = []
         for _ in range(n):
             frame = self.current_frame

@@ -1,0 +1,745 @@
+"""Relay tier + broadcast spectator fan-out (relay/).
+
+Covers the whole robustness surface: exact XOR/RLE delta codec (round-trip
+property + strict corruption rejection), bitwise stream reconstruction over
+a full recorded session, the forwarding plane (peers sync and run entirely
+through the relay; late-join state transfer rides types 9/10 inside
+RelayForward envelopes unchanged), the per-subscriber degradation ladder
+(full deltas -> keyframe-only -> shed -> cursor resume), and the acceptance
+soak: relay killed mid-match + lossy/reordered spectator links, asserting
+zero desync and a bounded spectator lag after recovery.
+"""
+
+import json
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu.chaos import (
+    ChaosPlan,
+    ChaosSocket,
+    LossBurst,
+    Partition,
+    RelayKillRestart,
+    Reorder,
+)
+from bevy_ggrs_tpu.models import box_game
+from bevy_ggrs_tpu.relay import (
+    RELAY_CONTROL,
+    RelayServer,
+    RelaySocket,
+    StateCodec,
+    StatePublisher,
+    StreamSpectator,
+    delta_apply,
+    delta_encode,
+    payload_digest,
+    peer_addr,
+)
+from bevy_ggrs_tpu.relay.server import MODE_FULL, MODE_KEYFRAME
+from bevy_ggrs_tpu.runner import RollbackRunner
+from bevy_ggrs_tpu.session import (
+    EventKind,
+    PlayerType,
+    SessionBuilder,
+    SessionState,
+)
+from bevy_ggrs_tpu.session.requests import AdvanceFrame
+from bevy_ggrs_tpu.session.supervisor import SessionSupervisor
+from bevy_ggrs_tpu.state import ring_frame_at, ring_load, to_host
+from bevy_ggrs_tpu.transport.loopback import LoopbackNetwork
+from bevy_ggrs_tpu.utils.metrics import Metrics
+from tests.test_p2p import FPS_DT, make_pair, scripted_input
+from tests.test_supervisor import MAX_PRED, settled_checksums, sup_step
+
+
+class FakeSocket:
+    """Capture-only socket: records sends, replays queued inbound."""
+
+    def __init__(self, addr=("fake", 0)):
+        self.addr = addr
+        self.sent = []
+        self.inbox = []
+
+    def send_to(self, data, addr):
+        self.sent.append((bytes(data), addr))
+
+    def receive_all(self):
+        out, self.inbox = self.inbox, []
+        return out
+
+    def close(self):
+        pass
+
+
+def make_relay_peer(net, n, me, relays, disconnect_timeout=1.0, session_id=7):
+    """A supervised peer whose ONLY transport is the relay: every remote
+    player is addressed by its logical ``("relay-peer", h)`` address."""
+    inner = net.socket(("peer", me))
+    rsock = RelaySocket(
+        inner, relays, session_id=session_id, peer_id=me,
+        clock=lambda: net.now,
+    )
+    builder = (
+        SessionBuilder(box_game.INPUT_SPEC)
+        .with_num_players(n)
+        .with_max_prediction_window(MAX_PRED)
+        .with_disconnect_timeout(disconnect_timeout)
+    )
+    for h in range(n):
+        builder.add_player(
+            PlayerType.local() if h == me else PlayerType.remote(peer_addr(h)),
+            h,
+        )
+    session = builder.start_p2p_session(rsock, clock=lambda: net.now)
+    runner = RollbackRunner(
+        box_game.make_schedule(),
+        box_game.make_world(n).commit(),
+        max_prediction=MAX_PRED,
+        num_players=n,
+        input_spec=box_game.INPUT_SPEC,
+    )
+    metrics = Metrics()
+    sup = SessionSupervisor(session, runner, metrics=metrics)
+    return session, runner, sup, metrics
+
+
+# ---------------------------------------------------------------------------
+# Delta codec
+# ---------------------------------------------------------------------------
+
+
+class TestDeltaCodec:
+    def test_roundtrip_property(self):
+        """Property-based: for random buffer pairs of many shapes —
+        identical, sparse edits, dense noise, edits at both ends — a
+        keyframe + delta reconstructs the target bitwise."""
+        rng = np.random.RandomState(1234)
+        for trial in range(40):
+            size = int(rng.randint(1, 5000))
+            prev = rng.bytes(size)
+            kind = trial % 4
+            if kind == 0:
+                cur = prev  # no-op frame
+            elif kind == 1:  # sparse single-byte edits (the SoA common case)
+                buf = bytearray(prev)
+                for _ in range(int(rng.randint(1, max(2, size // 50)))):
+                    buf[int(rng.randint(0, size))] ^= int(rng.randint(1, 256))
+                cur = bytes(buf)
+            elif kind == 2:
+                cur = rng.bytes(size)  # dense change
+            else:  # first + last byte (boundary tokens)
+                buf = bytearray(prev)
+                buf[0] ^= 0xFF
+                buf[-1] ^= 0xFF
+                cur = bytes(buf)
+            d = delta_encode(prev, cur)
+            if cur == prev:
+                assert d == b""
+            got = delta_apply(prev, d, expect_crc=zlib.crc32(cur))
+            assert got == cur, f"trial {trial} ({size}B, kind {kind})"
+
+    def test_sparse_edit_encodes_small(self):
+        rng = np.random.RandomState(5)
+        prev = rng.bytes(4096)
+        buf = bytearray(prev)
+        for i in (10, 11, 2000, 4000):
+            buf[i] ^= 0x55
+        d = delta_encode(prev, bytes(buf))
+        assert 0 < len(d) < 64  # 3 tokens, a few bytes each
+
+    def test_mismatched_lengths_rejected(self):
+        with pytest.raises(ValueError):
+            delta_encode(b"abcd", b"abcde")
+
+    def test_truncated_delta_rejected(self):
+        """Every strict prefix of a valid delta must raise — either the
+        token stream breaks, or the crc of the wrong result catches it."""
+        rng = np.random.RandomState(9)
+        prev = rng.bytes(600)
+        cur = rng.bytes(600)
+        d = delta_encode(prev, cur)
+        crc = zlib.crc32(cur)
+        assert len(d) > 4
+        for k in range(len(d)):
+            with pytest.raises(ValueError):
+                delta_apply(prev, d[:k], expect_crc=crc)
+
+    def test_corrupted_delta_rejected(self):
+        """Single bit flips anywhere in the payload must never yield a
+        silently-wrong state: structure check or crc rejects them."""
+        rng = np.random.RandomState(10)
+        prev = rng.bytes(800)
+        buf = bytearray(prev)
+        for i in range(0, 800, 37):
+            buf[i] ^= 0xA5
+        cur = bytes(buf)
+        d = delta_encode(prev, cur)
+        crc = zlib.crc32(cur)
+        for _ in range(60):
+            pos = int(rng.randint(0, len(d)))
+            bit = 1 << int(rng.randint(0, 8))
+            bad = bytearray(d)
+            bad[pos] ^= bit
+            with pytest.raises(ValueError):
+                delta_apply(prev, bytes(bad), expect_crc=crc)
+
+    def test_trailing_garbage_rejected(self):
+        prev = b"\x00" * 64
+        cur = b"\x00" * 32 + b"\xff" * 32
+        d = delta_encode(prev, cur)
+        with pytest.raises(ValueError):
+            # Extra token pointing past the buffer.
+            delta_apply(prev, d + b"\x7f\x01\x00", expect_crc=zlib.crc32(cur))
+
+
+class TestStateCodec:
+    def test_world_roundtrip_bitwise(self):
+        world = box_game.make_world(2).commit()
+        codec = StateCodec.for_state(world)
+        data = codec.encode(world)
+        assert len(data) == codec.size
+        host = codec.decode(data)
+        ref = to_host(world)
+
+        def compare(a, b):
+            if isinstance(a, dict):
+                assert sorted(a) == sorted(b)
+                for k in a:
+                    compare(a[k], b[k])
+            else:
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        compare(ref, host)
+        # Re-encoding the decoded tree is byte-identical (layout is fixed).
+        assert codec.encode(host) == data
+        # And the WorldState path composes.
+        assert codec.encode(codec.decode_state(data)) == data
+
+    def test_template_mismatch_rejected(self):
+        world = box_game.make_world(2).commit()
+        codec = StateCodec.for_state(world)
+        host = codec.decode(codec.encode(world))
+        # Mutate one leaf's shape/dtype: the codec must refuse to encode a
+        # tree that no longer matches its pinned template.
+        path = codec._leaves[0][0]
+        node = host
+        for key in path[:-1]:
+            node = node[key]
+        node[path[-1]] = np.asarray(node[path[-1]]).ravel()[:-1]
+        with pytest.raises(ValueError):
+            codec.encode(host)
+        with pytest.raises(ValueError):
+            codec.decode(b"\x00" * (codec.size + 1))
+
+    def test_payload_digest_is_order_sensitive(self):
+        assert payload_digest(b"ab") != payload_digest(b"ba")
+        assert payload_digest(b"") != payload_digest(b"\x00")
+
+
+# ---------------------------------------------------------------------------
+# Stream exactness over a full recorded session
+# ---------------------------------------------------------------------------
+
+
+class TestStreamExactness:
+    def test_full_session_reconstructs_bitwise(self):
+        """Acceptance: record a real 2-peer match's publish stream, then
+        replay it datagram-by-datagram through a StreamSpectator — EVERY
+        reconstructed frame must equal the authoritative ring state
+        bitwise, including across keyframe boundaries."""
+        net = LoopbackNetwork()
+        peers = make_pair(net)
+        host_session, host_runner = peers[0]
+        capture = FakeSocket()
+        pub = StatePublisher(
+            host_session, host_runner, socket=capture,
+            keyframe_interval=7, max_frames_per_publish=1,
+        )
+        authoritative = {}
+        for _ in range(240):
+            net.advance(FPS_DT)
+            for session, runner in peers:
+                session.poll_remote_clients()
+                if session.current_state() != SessionState.RUNNING:
+                    continue
+                for h in session.local_player_handles():
+                    session.add_local_input(
+                        h, scripted_input(h, session.current_frame)
+                    )
+                from bevy_ggrs_tpu.session import PredictionThreshold
+
+                try:
+                    runner.handle_requests(session.advance_frame(), session)
+                except PredictionThreshold:
+                    pass
+            before = pub.published_frames
+            pub.publish(net.now)
+            if pub.published_frames > before:
+                # max_frames_per_publish=1 -> exactly this frame went out.
+                authoritative[pub._prev_frame] = pub._prev
+
+        assert len(authoritative) >= 150
+        assert pub.codec is not None
+
+        # Offline replay: one datagram per poll, one delta applied per
+        # poll (max_apply_per_poll=1) — the tightest possible pacing.
+        spec_sock = FakeSocket()
+        spec = StreamSpectator(
+            spec_sock, relays=[capture.addr], codec=pub.codec,
+            clock=lambda: 0.0, resub_timeout=1e9, max_apply_per_poll=1,
+        )
+        frames_checked = 0
+        for data, _addr in capture.sent:
+            spec_sock.inbox.append((capture.addr, data))
+            prev_frame = spec.current_frame
+            spec.poll(0.0)
+            # Drain the apply queue completely before the next datagram.
+            while spec.current_frame != prev_frame:
+                if spec.current_frame in authoritative:
+                    assert spec.state_bytes == authoritative[spec.current_frame]
+                    frames_checked += 1
+                prev_frame = spec.current_frame
+                spec.poll(0.0)
+
+        assert spec.keyframes_applied >= 5  # interval 7 over 150+ frames
+        assert spec.deltas_applied >= 100
+        assert frames_checked >= 150
+        assert spec.current_frame == max(authoritative)
+
+        # Anchor against a fully independent serial replay of the scripted
+        # inputs: the stream is exact w.r.t. the true trajectory, not just
+        # w.r.t. the publisher's own ring.
+        F = spec.current_frame
+        ref = RollbackRunner(
+            box_game.make_schedule(),
+            box_game.make_world(2).commit(),
+            max_prediction=8,
+            num_players=2,
+            input_spec=box_game.INPUT_SPEC,
+        )
+        for f in range(F):
+            bits = np.stack([scripted_input(h, f) for h in range(2)])
+            ref.handle_requests(
+                [AdvanceFrame(bits=bits, status=np.zeros(2, np.int32))]
+            )
+        assert pub.codec.encode(ref.world()) == spec.state_bytes
+
+    def test_publisher_reseeds_keyframe_on_epoch_change(self):
+        """A relay restart (epoch change) with no new settled frame must
+        re-send the last published state as a keyframe so the fresh relay
+        buffer can serve subscribers."""
+
+        class _EpochSock(FakeSocket):
+            def __init__(self):
+                super().__init__()
+                self.dirty = False
+
+            def consume_epoch_change(self):
+                d, self.dirty = self.dirty, False
+                return d
+
+        net = LoopbackNetwork()
+        peers = make_pair(net)
+        host_session, host_runner = peers[0]
+        sock = _EpochSock()
+        pub = StatePublisher(host_session, host_runner, socket=sock)
+        from tests.test_p2p import drive
+
+        drive(net, peers, scripted_input, 90)
+        pub.publish(net.now)
+        assert pub.published_frames > 0
+        n_sent = len(sock.sent)
+        sock.dirty = True
+        pub.publish(net.now)  # no new settled frames, but epoch changed
+        from bevy_ggrs_tpu.session import protocol as proto
+
+        reseed = [proto.decode(d) for d, _ in sock.sent[n_sent:]]
+        assert reseed and all(
+            isinstance(m, proto.StreamKeyframe) for m in reseed
+        )
+        assert reseed[0].frame == pub._prev_frame
+
+
+# ---------------------------------------------------------------------------
+# Forwarding plane
+# ---------------------------------------------------------------------------
+
+
+class TestRelayForwarding:
+    def test_peers_sync_and_run_through_relay(self):
+        """Two peers whose only route is the relay: sync handshake, input
+        exchange, and desync detection all ride RelayForward envelopes;
+        confirmed checksums agree bitwise."""
+        net = LoopbackNetwork()
+        relay_metrics = Metrics()
+        relay = RelayServer(
+            net.socket(("relay", 0)), clock=lambda: net.now,
+            metrics=relay_metrics,
+        )
+        a = make_relay_peer(net, 2, 0, [("relay", 0)])
+        b = make_relay_peer(net, 2, 1, [("relay", 0)])
+        events = []
+        for _ in range(280):
+            net.advance(FPS_DT)
+            relay.pump(net.now)
+            for peer in (a, b):
+                sup_step(net, peer, scripted_input, events)
+
+        assert a[0].current_state() == SessionState.RUNNING
+        assert b[0].current_state() == SessionState.RUNNING
+        assert a[0].current_frame > 120 and b[0].current_frame > 120
+        assert not any(e.kind == EventKind.DESYNC_DETECTED for e in events)
+        assert not any(e.kind == EventKind.DISCONNECTED for e in events)
+        # No failovers: the single relay stayed up.
+        assert a[0].socket.failovers == 0
+        frames, rows = settled_checksums([a[0], b[0]])
+        assert len(frames) >= 3
+        for f, row in zip(frames, rows):
+            assert row[0] == row[1], f"frame {f} diverged through relay"
+        assert relay_metrics.counters["relay_forwarded"] > 200
+        # Spoofed envelopes (src not matching registration) are dropped.
+        bad = net.socket(("intruder", 0))
+        from bevy_ggrs_tpu.session import protocol as proto
+
+        bad.send_to(
+            proto.encode(proto.RelayForward(0, 1, b"\x00")), ("relay", 0)
+        )
+        net.advance(FPS_DT)
+        relay.pump(net.now)
+        assert relay_metrics.counters["relay_forward_rejected"] >= 1
+
+    def test_late_join_state_transfer_rides_relay(self):
+        """Types 9/10 reuse: a crashed peer rejoins THROUGH the relay —
+        the supervisor's chunked state transfer travels inside
+        RelayForward envelopes without the relay understanding it."""
+        net = LoopbackNetwork()
+        relay = RelayServer(net.socket(("relay", 0)), clock=lambda: net.now)
+        a = make_relay_peer(net, 2, 0, [("relay", 0)], disconnect_timeout=0.5)
+        b = make_relay_peer(net, 2, 1, [("relay", 0)], disconnect_timeout=0.5)
+        ev_a = []
+
+        def run(iters, peers):
+            for _ in range(iters):
+                net.advance(FPS_DT)
+                relay.pump(net.now)
+                for peer in peers:
+                    sup_step(net, peer, scripted_input,
+                             ev_a if peer is a else None)
+
+        run(60, [a, b])
+        assert a[0].current_state() == SessionState.RUNNING
+
+        # B dies: inner socket closes, relay registration goes stale.
+        b[0].socket.close()
+        run(60, [a])
+        assert a[3].counters["peer_disconnects"] == 1
+        frame_at_restart = a[0].current_frame
+
+        # B restarts at the same logical peer id (new inner socket) and
+        # asks peer 0 — by its LOGICAL relay address — for a checkpoint.
+        b2 = make_relay_peer(net, 2, 1, [("relay", 0)], disconnect_timeout=0.5)
+        b2[2].begin_rejoin(peer_addr(0))
+        run(220, [a, b2])
+
+        assert b2[3].counters["recoveries"] == 1
+        assert a[3].counters["state_transfers_served"] >= 1
+        assert any(e.kind == EventKind.PLAYER_REJOINED for e in ev_a)
+        assert b2[0].current_frame > frame_at_restart
+        frames, rows = settled_checksums([a[0], b2[0]])
+        tail = [(f, r) for f, r in zip(frames, rows) if f > frame_at_restart]
+        assert len(tail) >= 3
+        for f, row in tail:
+            assert row[0] == row[1], f"frame {f} diverged after relay rejoin"
+
+
+# ---------------------------------------------------------------------------
+# Degradation ladder
+# ---------------------------------------------------------------------------
+
+
+def _fanout_fixture(net, relay_kwargs, spec_plan):
+    """Relay + 2 relay-peers + publisher on peer 0 + one chaos-wrapped
+    StreamSpectator. Returns (relay, peers, pub, spec, spec_metrics)."""
+    relay = RelayServer(
+        net.socket(("relay", 0)), clock=lambda: net.now, metrics=Metrics(),
+        **relay_kwargs,
+    )
+    a = make_relay_peer(net, 2, 0, [("relay", 0)])
+    b = make_relay_peer(net, 2, 1, [("relay", 0)])
+    pub = StatePublisher(
+        a[0], a[1], socket=a[0].socket, keyframe_interval=10,
+    )
+    spec_inner = net.socket(("spec", 0))
+    spec_sock = ChaosSocket(
+        spec_inner, spec_plan, clock=lambda: net.now, addr=("spec", 0)
+    )
+    spec_metrics = Metrics()
+    spec = StreamSpectator(
+        spec_sock, relays=[("relay", 0)], session_id=7, window=8,
+        codec=StateCodec.for_state(box_game.make_world(2).commit()),
+        clock=lambda: net.now, resub_timeout=0.4, metrics=spec_metrics,
+    )
+    return relay, (a, b), pub, spec, spec_metrics
+
+
+class TestDegradationLadder:
+    def test_stalled_acks_degrade_to_keyframes_then_recover(self):
+        """Ack loss past ``degrade_after`` pumps drops the subscriber to
+        keyframe-only; the first ack at the newest keyframe promotes it
+        back to full deltas."""
+        net = LoopbackNetwork()
+        # Spectator sends (acks) vanish for 0.5s: longer than
+        # degrade_after pumps, shorter than shed_after.
+        plan = ChaosPlan(21, (Partition(1.0, 1.5, src=("spec", 0)),))
+        relay, peers, pub, spec, _ = _fanout_fixture(
+            net, dict(degrade_after=8, shed_after=5.0), plan
+        )
+        modes = set()
+        for _ in range(210):
+            net.advance(FPS_DT)
+            relay.pump(net.now)
+            for peer in peers:
+                sup_step(net, peer, scripted_input)
+            pub.publish(net.now)
+            spec.poll(net.now)
+            m = relay.subscriber_mode(("spec", 0))
+            if m is not None and net.now > 1.0:
+                modes.add(m)
+
+        assert MODE_KEYFRAME in modes  # ladder engaged during the stall
+        assert relay.metrics.counters["fanout_degraded"] >= 1
+        assert relay.metrics.counters["fanout_recovered"] >= 1
+        assert relay.subscriber_mode(("spec", 0)) == MODE_FULL
+        assert spec.keyframes_applied >= 2  # survived ON keyframes
+        assert spec.frames_behind() <= 8  # converged after the heal
+        assert spec.state_bytes is not None
+
+    def test_silent_subscriber_shed_then_cursor_resume(self):
+        """No acks for ``shed_after`` seconds sheds the subscriber; it
+        resumes by re-subscribing with its cursor and is never sent the
+        frames it already holds."""
+        net = LoopbackNetwork()
+        plan = ChaosPlan(22, (Partition(1.0, 2.0, src=("spec", 0)),))
+        relay, peers, pub, spec, _ = _fanout_fixture(
+            net, dict(degrade_after=8, shed_after=0.6), plan
+        )
+        shed_seen = False
+        resume_cursor = None
+        for _ in range(240):
+            net.advance(FPS_DT)
+            relay.pump(net.now)
+            for peer in peers:
+                sup_step(net, peer, scripted_input)
+            pub.publish(net.now)
+            frame_before = spec.current_frame
+            spec.poll(net.now)
+            if relay.subscriber_count() == 0 and spec.state_bytes is not None:
+                shed_seen = True
+                resume_cursor = max(
+                    frame_before if resume_cursor is None else resume_cursor,
+                    frame_before,
+                )
+            # Monotonic frontier: resume never rewinds the spectator.
+            assert spec.current_frame >= frame_before
+
+        assert shed_seen
+        assert relay.metrics.counters["fanout_shed"] >= 1
+        # Re-admitted as a (re-)subscriber and fully converged.
+        assert relay.subscriber_count() == 1
+        assert relay.metrics.counters["fanout_subscribed"] >= 2
+        assert spec.failovers >= 1  # silence-driven re-subscribe path
+        assert spec.current_frame > resume_cursor
+        assert spec.frames_behind() <= 8
+
+
+# ---------------------------------------------------------------------------
+# Acceptance soak: relay kill/restart + lossy spectator links
+# ---------------------------------------------------------------------------
+
+
+class TestRelayFailoverSoak:
+    def test_relay_killed_mid_match_zero_desync_bounded_spectator_lag(self):
+        """The tentpole soak. Primary relay dies mid-match (scripted in a
+        replayable ChaosPlan); peers re-handshake to the standby inside
+        the disconnect-timeout budget (zero desync, no disconnects); the
+        publisher re-seeds a keyframe on the epoch change; spectators on
+        lossy, reordered links fail over with their cursors and end
+        within an explicit lag bound, bitwise-exact vs a serial replay."""
+        net = LoopbackNetwork()
+        relays = [("relay", 0), ("relay", 1)]
+
+        # Every fault in one replayable artifact (satellite: the
+        # RelayKillRestart primitive mirrors peer KillRestart).
+        relay_plan = ChaosPlan(77, (
+            Reorder(1.5, 3.0, 0.2, delay=0.03),
+            Partition(3.2, 3.8, dst=("spec", 1)),
+            RelayKillRestart(4.5, ("relay", 0), 0.5),
+        ))
+        spec_plan = ChaosPlan(78, (LossBurst(1.0, 2.5, 0.25),))
+        assert relay_plan.relay_kill_restarts()[0].relay == ("relay", 0)
+
+        relay0 = RelayServer(
+            ChaosSocket(net.socket(("relay", 0)), relay_plan,
+                        clock=lambda: net.now, addr=("relay", 0)),
+            clock=lambda: net.now, metrics=Metrics(),
+        )
+        relay1 = RelayServer(
+            net.socket(("relay", 1)), clock=lambda: net.now, metrics=Metrics()
+        )
+
+        n = 3
+        peers = [make_relay_peer(net, n, me, relays) for me in range(n)]
+        pub = StatePublisher(
+            peers[0][0], peers[0][1], socket=peers[0][0].socket,
+            keyframe_interval=20,
+        )
+        codec = StateCodec.for_state(box_game.make_world(n).commit())
+        specs = []
+        for s in range(2):
+            inner = net.socket(("spec", s))
+            sock = ChaosSocket(inner, spec_plan, clock=lambda: net.now,
+                               addr=("spec", s))
+            specs.append(StreamSpectator(
+                sock, relays=list(relays), session_id=7, window=16,
+                codec=codec, clock=lambda: net.now, resub_timeout=0.6,
+            ))
+
+        # CI failure forensics: with GGRS_OBS_DIR set, flight recorders
+        # ride along per peer and everything is dumped BEFORE the
+        # assertions run, so a failing soak still uploads artifacts.
+        obs_dir = os.environ.get("GGRS_OBS_DIR")
+        recorders = {}
+        if obs_dir:
+            from bevy_ggrs_tpu.obs import FlightRecorder
+
+            recorders = {me: FlightRecorder() for me in range(n)}
+
+        kill = relay_plan.relay_kill_restarts()[0]
+        killed = restarted = False
+        events = []
+        for _ in range(int(7.5 / FPS_DT)):
+            net.advance(FPS_DT)
+            # Harness executes the scripted relay death, exactly like peer
+            # KillRestart: close the socket, rebuild after the window with
+            # a FRESH epoch (the restarted instance has an empty buffer).
+            if not killed and net.now >= kill.at:
+                relay0.close()
+                relay0, killed = None, True
+            if killed and not restarted and net.now >= kill.at + kill.down_for:
+                relay0 = RelayServer(
+                    net.socket(("relay", 0)), clock=lambda: net.now,
+                    metrics=Metrics(),
+                )
+                restarted = True
+            if relay0 is not None:
+                relay0.pump(net.now)
+            relay1.pump(net.now)
+            for me, peer in enumerate(peers):
+                sup_step(net, peer, scripted_input, events)
+                if recorders:
+                    recorders[me].capture(
+                        session=peer[0], runner=peer[1], supervisor=peer[2],
+                        now=net.now,
+                    )
+            pub.publish(net.now)
+            for spec in specs:
+                spec.poll(net.now)
+
+        if obs_dir:
+            os.makedirs(obs_dir, exist_ok=True)
+            for me, rec in recorders.items():
+                rec.export_jsonl(
+                    os.path.join(obs_dir, f"relay_soak_peer{me}_frames.jsonl")
+                )
+            with open(os.path.join(obs_dir, "relay_soak_fanout.json"), "w") as f:
+                json.dump({
+                    "plan": json.loads(relay_plan.to_json()),
+                    "standby_relay_counters": dict(relay1.metrics.counters),
+                    "spectators": [
+                        {"frame": s.current_frame, "behind": s.frames_behind(),
+                         "failovers": s.failovers,
+                         "keyframes": s.keyframes_applied,
+                         "deltas": s.deltas_applied}
+                        for s in specs
+                    ],
+                }, f, indent=2)
+
+        # --- zero desync, no disconnects, peers advanced normally -------
+        assert restarted
+        assert not any(e.kind == EventKind.DESYNC_DETECTED for e in events)
+        assert not any(e.kind == EventKind.DISCONNECTED for e in events)
+        for session, _, sup, _ in peers:
+            assert session.current_state() == SessionState.RUNNING
+            assert session.current_frame > 300
+            assert not session._disconnected
+            # Every peer hopped to the standby when the primary died.
+            assert session.socket.failovers >= 1
+        # The checksum window retains only the most recent settled
+        # exchanges — all of them POST-failover here, which is the window
+        # that matters.
+        frames, rows = settled_checksums([p[0] for p in peers])
+        assert len(frames) >= 3
+        assert frames[-1] > 300  # the agreement frontier kept advancing
+        for f, row in zip(frames, rows):
+            assert len(set(row)) == 1, f"frame {f} desynced across peers"
+
+        # --- publisher rode the epoch change with a keyframe re-seed ----
+        assert pub.published_frames > 200
+
+        # --- spectators: failover + bounded resume ----------------------
+        # Drain: peers stop advancing; the stream flushes to its head.
+        for _ in range(30):
+            net.advance(FPS_DT)
+            relay0.pump(net.now)
+            relay1.pump(net.now)
+            for session, _, _, _ in peers:
+                session.poll_remote_clients()
+            pub.publish(net.now)
+            for spec in specs:
+                spec.poll(net.now)
+
+        SPECTATOR_LAG_BOUND = 8  # frames — THE acceptance bound
+        for s, spec in enumerate(specs):
+            assert spec.failovers >= 1, f"spec {s} never failed over"
+            assert spec.state_bytes is not None
+            assert spec.frames_behind() <= SPECTATOR_LAG_BOUND, (
+                f"spec {s} is {spec.frames_behind()} frames behind "
+                f"(bound {SPECTATOR_LAG_BOUND})"
+            )
+            assert spec.current_frame >= pub._prev_frame - SPECTATOR_LAG_BOUND
+
+        # --- bitwise exactness of the recovered stream ------------------
+        # Replay the scripted inputs serially to the spectator's frame:
+        # its reconstructed state must match the true trajectory exactly,
+        # straight through loss, reorder, and a relay death.
+        spec = specs[0]
+        assert spec.current_frame == pub._prev_frame  # fully caught up
+        F = spec.current_frame
+        ref = RollbackRunner(
+            box_game.make_schedule(),
+            box_game.make_world(n).commit(),
+            max_prediction=MAX_PRED,
+            num_players=n,
+            input_spec=box_game.INPUT_SPEC,
+        )
+        for f in range(F):
+            bits = np.stack([scripted_input(h, f) for h in range(n)])
+            ref.handle_requests(
+                [AdvanceFrame(bits=bits, status=np.zeros(n, np.int32))]
+            )
+        assert codec.encode(ref.world()) == spec.state_bytes
+
+    def test_relay_kill_restart_plan_roundtrip(self):
+        """The relay-death script survives JSON (the replay artifact)."""
+        plan = ChaosPlan.generate(
+            5, 8.0, peers=(("peer", 0),), relay=("relay", 0)
+        )
+        kills = plan.relay_kill_restarts()
+        assert len(kills) == 1 and kills[0].relay == ("relay", 0)
+        back = ChaosPlan.from_json(plan.to_json())
+        assert back == plan
+        assert back.relay_kill_restarts()[0].relay == ("relay", 0)
+        assert plan.horizon() >= kills[0].at + kills[0].down_for

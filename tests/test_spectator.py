@@ -143,3 +143,39 @@ class TestSpectator:
         net = LoopbackNetwork()
         spec_session, _ = make_spectator(net, ("peer", 0))
         assert spec_session.local_player_handles() == []
+
+    def test_catchup_burst_is_hard_capped_per_call(self):
+        """A spectator hundreds of frames behind (shed/partition resume)
+        must converge over several polls, never one unbounded dispatch
+        burst — ``CATCHUP_BURST_CAP`` binds even a huge
+        ``max_frames_behind``."""
+        from bevy_ggrs_tpu.session.endpoint import PeerState
+        from bevy_ggrs_tpu.session.spectator import (
+            CATCHUP_BURST_CAP,
+            SpectatorSession,
+        )
+
+        net = LoopbackNetwork()
+        session = SpectatorSession(
+            2,
+            box_game.INPUT_SPEC,
+            net.socket(("spec", 9)),
+            ("peer", 0),
+            max_frames_behind=10_000,
+            clock=lambda: net.now,
+        )
+        session._endpoint.state = PeerState.RUNNING
+        for h in range(2):
+            for f in range(500):
+                session._queues[h].add_input(f, scripted_input(h, f))
+
+        requests = session.advance_frame()
+        assert len(requests) == CATCHUP_BURST_CAP
+        assert session.current_frame == CATCHUP_BURST_CAP
+        # Repeated calls drain the backlog in bounded slices.
+        total = len(requests)
+        while session.current_frame < 499:
+            batch = session.advance_frame()
+            assert 1 <= len(batch) <= CATCHUP_BURST_CAP
+            total += len(batch)
+        assert total == session.current_frame
