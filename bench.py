@@ -1668,6 +1668,208 @@ def _relay_fanout_case() -> dict:
                " — BOUND EXCEEDED, reporting measured S instead") + ")"
         ),
     )
+# Batched multi-session serving (serve/, docs/serving.md): S concurrent
+# matches advanced by ONE vmapped dispatch. The headline column is
+# matches_per_chip_at_60hz = S * 16.7ms / tick_p99 — how many independent
+# matches one chip sustains at frame rate — gated on zero desyncs in the
+# in-bench serial-replay parity check and zero recompiles through churn.
+_SERVE_CONFIGS = {}
+for _m in ("box_game", "boids"):
+    for _S in (16, 64, 256, 1024):
+        _SERVE_CONFIGS[f"serve_batched_{_m}_S{_S}"] = (_m, _S)
+
+
+def _serve_script(num_players: int, seed: int, ticks: int) -> list:
+    """(requests, confirmed_frame) tick script in the canonical session
+    shape: 3 confirmed steps, a 2-deep predicted stall, then the rollback
+    recovery tick — the steady 60 Hz serving rhythm with one rollback per
+    6 ticks. Per-slot seeds give every match its own input stream (and its
+    own hit/miss mix against the branch tree)."""
+    from bevy_ggrs_tpu.session.requests import (
+        AdvanceFrame, LoadGameState, SaveGameState,
+    )
+
+    rng = np.random.RandomState(seed)
+
+    def adv(bits):
+        return AdvanceFrame(bits=np.asarray(bits, np.uint8),
+                            status=np.zeros(num_players, np.int32))
+
+    script, frame = [], 0
+    while len(script) < ticks:
+        for _ in range(3):
+            bits = rng.randint(0, 16, size=num_players)
+            script.append(([SaveGameState(frame), adv(bits)], frame))
+            frame += 1
+        frontier = frame - 1
+        pred = rng.randint(0, 16, size=num_players)
+        for d in range(2):
+            script.append(([SaveGameState(frame + d), adv(pred)], frontier))
+        frame += 2
+        reqs = [LoadGameState(frame - 2)]
+        for t in range(2):
+            bits = (pred if rng.rand() < 0.5
+                    else rng.randint(0, 16, size=num_players))
+            reqs += [SaveGameState(frame - 2 + t), adv(bits)]
+        reqs += [SaveGameState(frame),
+                 adv(rng.randint(0, 16, size=num_players))]
+        script.append((reqs, frame))
+        frame += 1
+    return script[:ticks]
+
+
+def _serve_batched_case(model: str, S: int) -> dict:
+    """Throughput + contracts of the batched serving core at S slots:
+    windowed per-tick time (all S matches advancing, spec ON, depth-2
+    rollback every 6th tick), a same-backend serial singleton baseline for
+    the per-match speedup, an in-bench bitwise parity replay of sampled
+    slots, and a churn phase asserted recompile-free via the XLA compile
+    counters."""
+    from bevy_ggrs_tpu.models import boids, box_game
+    from bevy_ggrs_tpu.serve.batch import BatchedSessionCore
+    from bevy_ggrs_tpu.spec_runner import SpeculativeRollbackRunner
+    from bevy_ggrs_tpu.state import checksum, combine64
+    from bevy_ggrs_tpu.utils import xla_cache
+
+    P, MAXPRED, B, F = 2, 4, 8, 4
+    if model == "boids":
+        schedule = boids.make_schedule()
+        initial = boids.make_world(64, P).commit()
+        input_spec = boids.INPUT_SPEC
+    else:
+        schedule = box_game.make_schedule()
+        initial = box_game.make_world(P).commit()
+        input_spec = box_game.INPUT_SPEC
+    ticks = int(os.environ.get("GGRS_SERVE_TICKS", "240") or "240")
+    warm, window = 6, 6  # cycle-aligned: every window sees one rollback
+    ticks = max(warm + 2 * window, ticks - ticks % window)
+    rtt0 = _host_device_rtt_ms()
+    xla_cache.install_compile_listeners()
+
+    core = BatchedSessionCore(
+        schedule, initial, MAXPRED, P, input_spec, num_slots=S,
+        num_branches=B, spec_frames=F,
+    )
+    core.warmup()
+    slots = [core.admit() for _ in range(S)]
+    scripts = {s: _serve_script(P, 1000 + s, ticks) for s in slots}
+    for t in range(warm):
+        core.tick({s: scripts[s][t] + (None,) for s in slots})
+    jax.block_until_ready(core.states)
+
+    times = []
+    t_idx = warm
+    while t_idx + window <= ticks:
+        t0 = time.perf_counter()
+        for t in range(t_idx, t_idx + window):
+            core.tick({s: scripts[s][t] + (None,) for s in slots})
+        jax.block_until_ready(core.states)
+        times.append((time.perf_counter() - t0) * 1000.0 / window)
+        t_idx += window
+    ran = t_idx  # ticks actually driven (warm + whole windows)
+    tick_p50 = float(np.percentile(times, 50))
+    tick_p99 = float(np.percentile(times, 99))
+
+    # Parity: replay sampled slots' full scripts through fresh serial
+    # singletons; committed state, frame and ring checksums must be
+    # bitwise-equal (the zero-desync gate — counters may differ, state
+    # may not; see docs/serving.md).
+    desyncs = 0
+    sample = sorted({slots[0], slots[S // 2], slots[-1]})
+    for s in sample:
+        oracle = SpeculativeRollbackRunner(
+            schedule, initial, max_prediction=MAXPRED, num_players=P,
+            input_spec=input_spec, num_branches=B, spec_frames=F,
+        )
+        oracle.warmup()
+        for reqs, confirmed in scripts[s][:ran]:
+            oracle.tick(reqs, confirmed, None)
+        ok = (
+            core.slots[s].frame == oracle.frame
+            and combine64(checksum(core.slot_state(s)))
+            == combine64(checksum(oracle.state))
+            and np.array_equal(
+                np.asarray(core.rings.checksums)[s],
+                np.asarray(oracle.ring.checksums),
+            )
+        )
+        desyncs += 0 if ok else 1
+
+    # Churn: retire/readmit under load — the compiled-variant count and
+    # the backend-compile counter must not move (the zero-recompile
+    # acceptance contract).
+    compiles0 = xla_cache.compile_counters()["backend_compiles"]
+    cache0 = core._exec.cache_size()
+    churned = slots[: min(4, S)]
+    for s in churned:
+        core.retire(s)
+    readmitted = [core.admit() for _ in churned]
+    churn_scripts = {s: _serve_script(P, 9000 + s, 2 * window)
+                     for s in readmitted}
+    for t in range(2 * window):
+        core.tick({s: churn_scripts[s][t] + (None,) for s in readmitted})
+    jax.block_until_ready(core.states)
+    churn_recompiles = (
+        xla_cache.compile_counters()["backend_compiles"] - compiles0
+    )
+
+    # Serial singleton baseline, SAME backend and script shape: the
+    # per-match cost a dedicated runner pays, for the batching speedup.
+    serial = SpeculativeRollbackRunner(
+        schedule, initial, max_prediction=MAXPRED, num_players=P,
+        input_spec=input_spec, num_branches=B, spec_frames=F,
+    )
+    serial.warmup()
+    sticks = min(ran, 120)
+    sscript = _serve_script(P, 1000 + slots[0], sticks)
+    for t in range(warm):
+        serial.tick(*sscript[t], None)
+    jax.block_until_ready(serial.state)
+    stimes = []
+    t_idx = warm
+    while t_idx + window <= sticks:
+        t0 = time.perf_counter()
+        for t in range(t_idx, t_idx + window):
+            serial.tick(*sscript[t], None)
+        jax.block_until_ready(serial.state)
+        stimes.append((time.perf_counter() - t0) * 1000.0 / window)
+        t_idx += window
+    serial_per_match = float(np.percentile(stimes, 50))
+
+    per_match = tick_p50 / S
+    frame_ms = 1000.0 / 60.0
+    return _entry(
+        f"serve_batched_{model}_S{S}",
+        tick_p50, S, B,
+        rtt_ms=rtt0,
+        sessions=S,
+        model=model,
+        ticks=int(ran),
+        tick_p50_ms=round(tick_p50, 4),
+        tick_p99_ms=round(tick_p99, 4),
+        per_match_ms=round(per_match, 5),
+        serial_per_match_ms=round(serial_per_match, 4),
+        per_match_speedup=round(serial_per_match / per_match, 2),
+        matches_per_chip_at_60hz=int(S * frame_ms / tick_p99),
+        desyncs=desyncs,
+        parity_slots_checked=len(sample),
+        churn_recompiles=int(churn_recompiles),
+        cache_size_stable=bool(core._exec.cache_size() == cache0),
+        notes=(
+            "spec-ON, depth-2 rollback every 6th tick on every match; "
+            "capacity gated on desyncs == 0 (bitwise serial-replay parity) "
+            "and churn_recompiles == 0"
+            + (
+                "; CPU executes the vmapped lanes serially, so the speedup "
+                "is overhead amortization only — the >=10x per-match "
+                "target is a lane-parallel-backend claim (see "
+                "docs/benchmarking.md, 'Batched multi-session serving')"
+                if jax.devices()[0].platform == "cpu" else ""
+            )
+        ),
+    )
+
+
 # _cpuhost variants force the CPU backend (a LOCAL device): they
 # demonstrate the framework's host path meets the render deadline when
 # dispatch isn't tunnel-bound — the fair live reading for this
@@ -1704,6 +1906,9 @@ def run_config(name: str) -> dict:
         return _live_multihost_case()
     if name in _RELAY_CONFIGS:
         return _relay_fanout_case()
+    if name in _SERVE_CONFIGS:
+        model, S = _SERVE_CONFIGS[name]
+        return _serve_batched_case(model, S)
     if name in _LIVE_CONFIGS:
         model, speculate, transport = _LIVE_CONFIGS[name]
         rtt0 = _host_device_rtt_ms()
@@ -1727,7 +1932,8 @@ def run_matrix() -> list:
     platform = None
     for name in (list(_CONFIGS) + list(_RECOVERY_CONFIGS)
                  + list(_LIVE_CONFIGS) + list(_EIGHTP_CONFIGS)
-                 + list(_MULTIHOST_CONFIGS) + list(_RELAY_CONFIGS)):
+                 + list(_MULTIHOST_CONFIGS) + list(_RELAY_CONFIGS)
+                 + list(_SERVE_CONFIGS)):
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--config", name],
             capture_output=True, text=True, cwd=os.path.dirname(
@@ -1802,7 +2008,8 @@ def main() -> None:
         idx = args.index("--config") + 1
         valid = (list(_CONFIGS) + list(_RECOVERY_CONFIGS)
                  + list(_LIVE_CONFIGS) + list(_EIGHTP_CONFIGS)
-                 + list(_MULTIHOST_CONFIGS) + list(_RELAY_CONFIGS))
+                 + list(_MULTIHOST_CONFIGS) + list(_RELAY_CONFIGS)
+                 + list(_SERVE_CONFIGS))
         if idx >= len(args) or args[idx] not in valid:
             print(f"bench: --config needs one of: {', '.join(valid)}",
                   file=sys.stderr)

@@ -43,6 +43,70 @@ from bevy_ggrs_tpu.rollout import rollout_burst
 from bevy_ggrs_tpu.schedule import PREDICTED, Schedule
 from bevy_ggrs_tpu.state import SnapshotRing, WorldState, ring_load
 
+# Memoized jit-argument scalars, shared process-wide. These used to live
+# per-executor instance, which was correct but wasteful under multi-session
+# serving: S matches of one model family share ONE compiled executable, and
+# keying the cached device scalars per-instance gave every match its own
+# copy of the same `jnp.asarray(v, int32)` — S duplicate host->device
+# transfers for every recurring frame number. The values are
+# executable-independent (plain uncommitted device scalars jit reshards as
+# needed), so one per-process cache is strictly more correct: keyed by
+# value, shared by every executor of every session.
+_I32_CACHE: dict = {}
+_BOOL_CACHE: dict = {}
+
+
+def _i32_cached(v: int):
+    a = _I32_CACHE.get(v)
+    if a is None:
+        if len(_I32_CACHE) > 65536:  # frame numbers are unbounded
+            # Evict only the unbounded frame-number keys; small constants
+            # (branch counts, depths, span lengths < 4096) are the
+            # per-tick hot set and repopulating them after a blanket
+            # clear() costs a host->device transfer burst on the dispatch
+            # path.
+            for k in [k for k in _I32_CACHE if not 0 <= k < 4096]:
+                del _I32_CACHE[k]
+        a = jnp.asarray(v, jnp.int32)
+        _I32_CACHE[v] = a
+    return a
+
+
+def _bool_cached(v: bool):
+    # Lazy (not module-level constants): importing this module must not
+    # execute a JAX op — backend selection may not have happened yet.
+    a = _BOOL_CACHE.get(v)
+    if a is None:
+        a = jnp.asarray(bool(v))
+        _BOOL_CACHE[v] = a
+    return a
+
+
+def _session_axis_wrap(fn, session_axis: int):
+    """Route a singleton tick through the SESSION-AXIS program: broadcast
+    every argument to a leading ``[S]`` axis, vmap the tick body over it,
+    and slice slot 0 back out — all inside one jitted program, still one
+    dispatch. Numerically this computes the singleton result through the
+    exact executable the batched :class:`~bevy_ggrs_tpu.serve.batch.
+    BatchedTickExecutor` compiles (vmap over a leading session axis), so
+    running the existing singleton suites with ``GGRS_SESSION_AXIS=N``
+    proves the batched program bitwise against every singleton oracle they
+    already encode. It is a conformance mode, not a serving mode: real
+    multi-session serving feeds S *distinct* slots through
+    ``serve.MatchServer`` instead of S copies of one."""
+
+    def wrapped(*args):
+        stacked = jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(
+                jnp.asarray(x)[None], (session_axis,) + jnp.shape(x)
+            ),
+            args,
+        )
+        out = jax.vmap(fn)(*stacked)
+        return jax.tree_util.tree_map(lambda x: x[0], out)
+
+    return wrapped
+
 
 def absorb_branch_frames(
     main_ring: SnapshotRing,
@@ -122,11 +186,13 @@ class FusedTickExecutor:
         branch_axis: str = "branch",
         entity_axis: Optional[str] = None,
         state_template: Optional[WorldState] = None,
+        session_axis: int = 0,
     ):
         self.schedule = schedule
         self.burst_frames = int(burst_frames)
         self.num_branches = int(num_branches)
         self.spec_frames = int(spec_frames)
+        self.session_axis = int(session_axis)
         # Layouts for caller-built branch-stacked placeholder buffers
         # (None = single-device; see SpeculativeRollbackRunner._prev_buffers).
         self.rings_sharding = None
@@ -134,17 +200,29 @@ class FusedTickExecutor:
         # Per-call `jnp.asarray` of ~15 scalars/constant tensors dominated
         # the dispatch cost (~70% of a 1.8 ms enqueue, profiled): traced
         # frame numbers recur and the masks/zero-pads are constant per
-        # n_burst, so memoize the device arrays and hit jit's C++ fast
-        # path with identical committed buffers.
-        self._i32_cache: dict = {}
-        self._bool_cache = {
-            False: jnp.asarray(False), True: jnp.asarray(True)
-        }
+        # n_burst, so the device arrays are memoized (module-level
+        # _i32_cached/_bool_cached, shared by every executor in the
+        # process) and jit's C++ fast path sees identical committed
+        # buffers tick over tick.
         self._burst_cache: dict = {}  # n_burst -> (valid, zero_bits, zero_status)
         self._spec_status = None
         run = functools.partial(
             self._tick_impl, schedule, self.burst_frames, self.spec_frames
         )
+        if self.session_axis > 0:
+            if mesh is not None:
+                raise ValueError(
+                    "session_axis (GGRS_SESSION_AXIS) and mesh sharding "
+                    "are mutually exclusive: the session axis vmaps the "
+                    "whole tick, which would replicate the entity-sharded "
+                    "layout per slot. Unset one."
+                )
+            self._fn = jax.jit(_session_axis_wrap(run, self.session_axis))
+            self._absorb = jax.jit(_session_axis_wrap(
+                functools.partial(self._absorb_impl, self.burst_frames),
+                self.session_axis,
+            ))
+            return
         if mesh is not None:
             from bevy_ggrs_tpu.parallel.sharding import (
                 branch_pspec,
@@ -289,19 +367,10 @@ class FusedTickExecutor:
     # ------------------------------------------------------------------
 
     def _i32(self, v: int):
-        a = self._i32_cache.get(v)
-        if a is None:
-            if len(self._i32_cache) > 65536:  # frame numbers are unbounded
-                # Evict only the unbounded frame-number keys; small
-                # constants (branch counts, depths, span lengths < 4096)
-                # are the per-tick hot set and repopulating them after a
-                # blanket clear() costs a host->device transfer burst on
-                # the dispatch path.
-                for k in [k for k in self._i32_cache if not 0 <= k < 4096]:
-                    del self._i32_cache[k]
-            a = jnp.asarray(v, jnp.int32)
-            self._i32_cache[v] = a
-        return a
+        # Delegates to the process-wide cache so S batched executors (and
+        # every per-slot code path in serve/) share one set of committed
+        # device scalars instead of S copies.
+        return _i32_cached(v)
 
     def commit_absorb(
         self,
@@ -412,12 +481,12 @@ class FusedTickExecutor:
             self._i32(absorb_n),
             self._i32(prev_anchor),
             self._i32(prev_total),
-            self._bool_cache[do_load],
+            _bool_cached(do_load),
             self._i32(load_frame if do_load else 0),
             self._i32(start_frame),
             bits_d, status_d,
             valid_d, valid_d,
-            self._bool_cache[bool(spec_from_live)],
+            _bool_cached(bool(spec_from_live)),
             self._i32(spec_anchor),
             bb, self._spec_status,
         )

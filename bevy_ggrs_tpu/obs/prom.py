@@ -15,6 +15,14 @@ Mapping:
 - series    -> a summary: ``{quantile="0.5|0.95|0.99"}`` samples plus
   ``_count`` and ``_sum`` (reconstructed as mean*count);
 - recorder  -> ``{ns}_rollback_depth`` cumulative histogram buckets.
+
+Labeled instruments (``Metrics.count(..., labels={"match_slot": s})``)
+arrive as ``name{k="v"}`` keys — the label block is split off, preserved
+verbatim, and re-attached after the ``_total``/``_per_sec``/quantile
+suffix, so per-slot serving metrics export as proper labeled samples
+(``ggrs_frames_advanced_total{match_slot="3"} 42``) instead of being
+mangled into one flat name per label set. ``# TYPE`` is emitted once per
+metric family, not once per label set.
 """
 
 from __future__ import annotations
@@ -32,6 +40,21 @@ def _sanitize(name: str) -> str:
     return clean
 
 
+def _split_labels(name: str):
+    """``name{k="v"}`` -> (sanitized base, '{k="v"}' | '')."""
+    if name.endswith("}") and "{" in name:
+        base, labels = name.split("{", 1)
+        return _sanitize(base), "{" + labels
+    return _sanitize(name), ""
+
+
+def _merge(labels: str, extra: str) -> str:
+    """Merge a preserved label block with an extra ``k="v"`` pair."""
+    if not labels:
+        return "{" + extra + "}"
+    return labels[:-1] + "," + extra + "}"
+
+
 def _num(v) -> str:
     f = float(v)
     return str(int(f)) if f.is_integer() else repr(f)
@@ -44,20 +67,29 @@ def export_prometheus(
     path: Optional[str] = None,
 ) -> str:
     lines = []
+    typed = set()  # one "# TYPE" per family across its label sets
+
+    def type_line(base: str, kind: str) -> None:
+        if base not in typed:
+            typed.add(base)
+            lines.append(f"# TYPE {base} {kind}")
+
     for name, stats in sorted(metrics.summary().items()):
-        base = f"{namespace}_{_sanitize(name)}"
+        raw_base, labels = _split_labels(name)
+        base = f"{namespace}_{raw_base}"
         if "total" in stats:  # counter
-            lines.append(f"# TYPE {base}_total counter")
-            lines.append(f"{base}_total {_num(stats['total'])}")
-            lines.append(f"# TYPE {base}_per_sec gauge")
-            lines.append(f"{base}_per_sec {_num(stats['per_sec'])}")
+            type_line(f"{base}_total", "counter")
+            lines.append(f"{base}_total{labels} {_num(stats['total'])}")
+            type_line(f"{base}_per_sec", "gauge")
+            lines.append(f"{base}_per_sec{labels} {_num(stats['per_sec'])}")
         else:  # series -> summary
             count = stats["count"]
-            lines.append(f"# TYPE {base} summary")
+            type_line(base, "summary")
             for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
-                lines.append(f'{base}{{quantile="{q}"}} {_num(stats[key])}')
-            lines.append(f"{base}_sum {_num(stats['mean'] * count)}")
-            lines.append(f"{base}_count {_num(count)}")
+                qlabels = _merge(labels, f'quantile="{q}"')
+                lines.append(f"{base}{qlabels} {_num(stats[key])}")
+            lines.append(f"{base}_sum{labels} {_num(stats['mean'] * count)}")
+            lines.append(f"{base}_count{labels} {_num(count)}")
     if recorder is not None:
         hist = recorder.rollback_histogram()
         base = f"{namespace}_rollback_depth"

@@ -50,6 +50,12 @@ class FrameRecord:
     peers: Dict[str, Dict[str, object]]
     faults: List[Tuple[float, str, str]]
     events: List[str]
+    # Batched-serving columns (None outside a MatchServer drive loop —
+    # appended with defaults so existing positional constructions and
+    # recorded JSONL stay stable).
+    slots_active: Optional[int] = None
+    slots_free: Optional[int] = None
+    stagger_jitter_ms: Optional[float] = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -72,6 +78,7 @@ class FlightRecorder:
         supervisor=None,
         events=(),
         now: Optional[float] = None,
+        server=None,
     ) -> FrameRecord:
         frame = NULL_FRAME
         confirmed = NULL_FRAME
@@ -130,6 +137,17 @@ class FlightRecorder:
             self._last_rollbacks = total_rb
             self._last_resim = total_resim
 
+        slots_active = slots_free = None
+        stagger_jitter = None
+        if server is not None:
+            # MatchServer (or anything exposing the same gauges): slot
+            # occupancy + how far the stagger-group dispatches drifted off
+            # their ideal offsets within the last served frame.
+            slots_active = int(getattr(server, "slots_active", 0))
+            slots_free = int(getattr(server, "slots_free", 0))
+            jitter = getattr(server, "last_stagger_jitter_ms", None)
+            stagger_jitter = None if jitter is None else float(jitter)
+
         health = None
         transition = None
         if supervisor is not None:
@@ -158,6 +176,9 @@ class FlightRecorder:
             peers=peers,
             faults=faults,
             events=[e.kind.name for e in events],
+            slots_active=slots_active,
+            slots_free=slots_free,
+            stagger_jitter_ms=stagger_jitter,
         )
         self._seq += 1
         self.records.append(rec)

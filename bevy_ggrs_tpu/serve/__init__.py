@@ -1,0 +1,20 @@
+"""Batched multi-session serving: one compiled program, S concurrent matches.
+
+``serve.batch`` holds the session-axis core — :class:`BatchedTickExecutor`
+(the fused tick vmapped over a leading slot axis) and
+:class:`BatchedSessionCore` (fixed-capacity slot lifecycle + the per-slot
+speculation host logic). ``serve.server`` drives it:
+:class:`MatchServer` multiplexes per-match sessions into slots, staggers
+group dispatches across the frame, and exposes the occupancy/jitter gauges
+the flight recorder captures.
+"""
+
+from bevy_ggrs_tpu.serve.batch import BatchedSessionCore, BatchedTickExecutor
+from bevy_ggrs_tpu.serve.server import MatchHandle, MatchServer
+
+__all__ = [
+    "BatchedSessionCore",
+    "BatchedTickExecutor",
+    "MatchHandle",
+    "MatchServer",
+]

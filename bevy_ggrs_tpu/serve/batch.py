@@ -1,0 +1,689 @@
+"""The session axis: S independent matches advanced by ONE fused dispatch.
+
+"Millions of users" is ~100k concurrent 2-8 player matches, not one giant
+world — and a per-session singleton pays its own dispatch, its own compile
+cache and its own slice of the 16.7 ms frame for every one of them. This
+module applies the Podracer/Anakin batched-environments shape (PAPERS.md,
+arXiv 2104.06272) to rollback sessions: the fused tick program
+(:meth:`~bevy_ggrs_tpu.fused.FusedTickExecutor._tick_impl` — absorb +
+serial burst + B-branch speculative rollout, every phase gated by traced
+scalars) vmaps cleanly over a leading slot axis, so one compiled
+executable advances S matches — each with its OWN frame counter, rollback
+depth and branch tree — per dispatch.
+
+Design rules that make the batch shape static (one executable, ever):
+
+- **Fixed capacity, padding + no-op masks.** The batch always carries S
+  slots. A slot with no work this dispatch runs with every phase no-op'd
+  (``absorb_n=0``, all burst masks False, ``do_load=False``) — the traced
+  gates that already pad heterogeneous burst depths in the singleton
+  program are exactly what makes an idle slot free of semantic effect.
+- **Admit/retire without recompiles.** Admission writes a fresh singleton
+  (ring, state) into a slot row via ``dynamic_update_index_in_dim`` with a
+  TRACED slot index — one jitted write program covers every slot.
+  Retirement is host-only bookkeeping (the stale rows are dead weight
+  until readmission). ``utils.xla_cache.compile_counters()`` is the
+  observable this contract is asserted against.
+- **No-op slots REPLAY their previous rollout.** The batched program
+  returns full ``[S, B, ...]`` speculative buffers which wholesale replace
+  the previous ones — so a slot that is not ticking must re-dispatch its
+  previous (anchor, from-live, branch tensor) rollout to keep its pending
+  branches valid. The recompute is bitwise-identical (same executable,
+  same anchor state — the slot's ring/state rows are untouched by its own
+  no-op phases), so the replacement is a no-op for that slot's data.
+- **Full hits re-dispatch.** The singleton runner's absorb-only fast path
+  and dedup-skip are latency optimizations for a session that owns the
+  whole chip; in a batch the program runs anyway, so a full hit is simply
+  absorb + empty tail + a fresh rollout. Hit/skip COUNTERS therefore
+  differ from a serial singleton run — committed state does not: commits
+  only ever absorb branch frames whose inputs matched the corrected
+  history exactly, computed by the attested executable. The parity suite
+  (tests/test_batched_sessions.py) compares state bytes, frames and ring
+  contents, which is the contract that matters.
+
+Host-side per-slot speculation (branch build, match, input log) reuses the
+singleton implementation verbatim: the native builder is instantiated per
+slot (it owns a per-match C++ input-log mirror) and the pure-Python
+fallback borrows :class:`~bevy_ggrs_tpu.spec_runner.
+SpeculativeRollbackRunner`'s tree-builder methods unbound through
+:class:`_SlotSpecShim` — bit-identical trees by construction, no forked
+logic to drift.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bevy_ggrs_tpu.fused import FusedTickExecutor, _i32_cached
+from bevy_ggrs_tpu.native import spec as native_spec
+from bevy_ggrs_tpu.parallel.speculate import match_branch
+from bevy_ggrs_tpu.runner import RollbackRunner, _Step
+from bevy_ggrs_tpu.schedule import PREDICTED, Schedule
+from bevy_ggrs_tpu.spec_runner import SpeculativeRollbackRunner
+from bevy_ggrs_tpu.state import SnapshotRing, WorldState, combine64, ring_init
+
+
+class BatchedTickExecutor:
+    """The fused tick vmapped over a leading ``[S]`` slot axis, plus the
+    traced-index admit program. One instance = one compiled executable;
+    every :class:`BatchedSessionCore` of the same model family (and every
+    stagger group of a :class:`~bevy_ggrs_tpu.serve.server.MatchServer`)
+    should share it."""
+
+    def __init__(
+        self,
+        schedule: Schedule,
+        num_slots: int,
+        burst_frames: int,
+        num_branches: int,
+        spec_frames: int,
+    ):
+        self.schedule = schedule
+        self.num_slots = int(num_slots)
+        self.burst_frames = int(burst_frames)
+        self.num_branches = int(num_branches)
+        self.spec_frames = int(spec_frames)
+        tick = functools.partial(
+            FusedTickExecutor._tick_impl, schedule, self.burst_frames,
+            self.spec_frames,
+        )
+        # 20 args; spec_status (the shared all-PREDICTED [F, P] constant)
+        # broadcasts, everything else carries the slot axis.
+        self._fn = jax.jit(jax.vmap(tick, in_axes=(0,) * 19 + (None,)))
+        self._admit = jax.jit(self._admit_impl)
+        self._spec_status = None
+
+    @staticmethod
+    def _admit_impl(rings, states, slot, new_ring, new_state):
+        write = lambda stacked, row: jax.tree_util.tree_map(
+            lambda R, r: jax.lax.dynamic_update_index_in_dim(R, r, slot, 0),
+            stacked, row,
+        )
+        return write(rings, new_ring), write(states, new_state)
+
+    def admit(self, rings, states, slot: int, new_ring, new_state):
+        """Write a fresh singleton (ring, state) into slot row ``slot`` of
+        the stacked trees. The index is traced — one compile covers every
+        slot, which is what makes match churn recompile-free."""
+        return self._admit(
+            rings, states, _i32_cached(slot), new_ring, new_state
+        )
+
+    def cache_size(self) -> int:
+        """Compiled-variant count of the batched tick program (-1 when the
+        jit internals don't expose it). 1 after warmup, and it must STAY 1
+        through any amount of match churn."""
+        probe = getattr(self._fn, "_cache_size", None)
+        return int(probe()) if probe is not None else -1
+
+    def run(
+        self,
+        rings, states, prev_rings, prev_states,
+        branch, absorb_first, absorb_n, prev_anchor, prev_total,
+        do_load, load_frame, start_frame,
+        bits, status, save_mask, adv_mask,
+        spec_from_live, spec_anchor, branch_bits,
+    ):
+        """Dispatch one batched tick. Scalar args are host ``[S]`` arrays,
+        tensors ``[S, ...]`` (all plain NumPy — jit's C++ fast path
+        transfers them during argument sharding); trees are the stacked
+        device pytrees. Returns the full 7-tuple, device-resident."""
+        if self._spec_status is None:
+            P = np.shape(branch_bits)[3]
+            self._spec_status = jnp.full(
+                (self.spec_frames, P), PREDICTED, dtype=jnp.int32
+            )
+        return self._fn(
+            rings, states, prev_rings, prev_states,
+            branch, absorb_first, absorb_n, prev_anchor, prev_total,
+            do_load, load_frame, start_frame,
+            bits, status, save_mask, adv_mask,
+            spec_from_live, spec_anchor, branch_bits, self._spec_status,
+        )
+
+
+class _SlotSpecShim:
+    """Adapter exposing exactly the attributes the singleton runner's
+    branch-tree methods read, so they can run UNBOUND against a per-slot
+    input log. Any drift between batched and singleton trees is therefore
+    impossible short of editing the singleton itself."""
+
+    _structured_bits = SpeculativeRollbackRunner._structured_bits
+    _candidate_values = SpeculativeRollbackRunner._candidate_values
+    _extrapolate_base = SpeculativeRollbackRunner._extrapolate_base
+    _history_fingerprint = SpeculativeRollbackRunner._history_fingerprint
+    _known_inputs = SpeculativeRollbackRunner._known_inputs
+
+    def __init__(
+        self, input_spec, num_players, num_branches, spec_frames,
+        branch_values, input_log,
+    ):
+        self.input_spec = input_spec
+        self.num_players = num_players
+        self.num_branches = num_branches
+        self.spec_frames = spec_frames
+        self._branch_values = branch_values
+        self._input_log = input_log
+
+
+class _Slot:
+    """Host-side record of one batch slot: match identity, frame counter,
+    per-slot input log / native builder, and the metadata of the pending
+    rollout living in row ``index`` of the core's prev buffers."""
+
+    __slots__ = (
+        "index", "active", "frame", "spec_on", "native", "input_log",
+        "shim", "res_anchor", "res_bits", "res_from_live",
+    )
+
+    def __init__(self, index: int):
+        self.index = index
+        self.active = False
+        self.frame = 0
+        self.spec_on = True
+        self.native = None
+        self.input_log: dict = {}
+        self.shim: Optional[_SlotSpecShim] = None
+        self.res_anchor: Optional[int] = None
+        self.res_bits: Optional[np.ndarray] = None
+        self.res_from_live = True
+
+
+class BatchedSessionCore:
+    """S fixed-capacity match slots over stacked device state, advanced by
+    one :class:`BatchedTickExecutor` dispatch per tick round.
+
+    The per-slot request protocol matches the singleton runner's canonical
+    tick: each slot submits one ``[Load?, (Save, Advance)*]`` segment per
+    round with saves labeled contiguously (the session layer produces
+    exactly this shape). ``RestoreGameState`` and non-standard bursts are
+    rejected — a match needing supervisor state transfer must be retired
+    to a singleton runner (slot state is extractable via
+    :meth:`slot_state`).
+
+    Determinism-per-slot: every slot's committed trajectory is computed by
+    the same vmapped executable regardless of what other slots are doing
+    (phase gates are per-slot; lanes never interact), so a slot's state
+    stream is bitwise-reproducible by a serial replay of its own inputs —
+    the guarantee docs/serving.md specifies and
+    tests/test_batched_sessions.py asserts.
+    """
+
+    def __init__(
+        self,
+        schedule: Schedule,
+        initial_state: WorldState,
+        max_prediction: int,
+        num_players: int,
+        input_spec,
+        num_slots: int,
+        num_branches: int = 8,
+        spec_frames: Optional[int] = None,
+        branch_values=None,
+        metrics=None,
+        tracer=None,
+        executor: Optional[BatchedTickExecutor] = None,
+        report_checksums: bool = True,
+    ):
+        from bevy_ggrs_tpu.obs.trace import null_tracer
+        from bevy_ggrs_tpu.utils.metrics import null_metrics
+
+        self.metrics = metrics if metrics is not None else null_metrics
+        self.tracer = tracer if tracer is not None else null_tracer
+        self.schedule = schedule
+        self.num_players = int(num_players)
+        self.input_spec = input_spec
+        self.max_prediction = int(max_prediction)
+        self.num_slots = int(num_slots)
+        self.spec_frames = int(spec_frames or max_prediction)
+        self.num_branches = int(num_branches)
+        self.report_checksums = bool(report_checksums)
+        if branch_values is not None:
+            self._branch_values = list(branch_values)
+        elif getattr(input_spec, "values", None):
+            self._branch_values = list(input_spec.values)
+        else:
+            self._branch_values = list(range(16))
+        # Ring/burst sizing mirrors RollbackRunner: depth = max_prediction
+        # + 1 slack, burst padded to max_prediction + 2.
+        self.ring_depth = self.max_prediction + 1
+        self.burst_frames = self.max_prediction + 2
+        if executor is not None:
+            if executor.num_slots != self.num_slots:
+                raise ValueError(
+                    f"shared executor has {executor.num_slots} slots, core "
+                    f"wants {self.num_slots}"
+                )
+            self._exec = executor
+        else:
+            self._exec = BatchedTickExecutor(
+                schedule, self.num_slots, self.burst_frames,
+                self.num_branches, self.spec_frames,
+            )
+        S, B, F = self.num_slots, self.num_branches, self.spec_frames
+        self._template = jax.tree_util.tree_map(jnp.asarray, initial_state)
+        bcast = lambda prefix: jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(
+                x.reshape((1,) * len(prefix) + x.shape), prefix + x.shape
+            ),
+            self._template,
+        )
+        self.states = bcast((S,))
+        self.rings = SnapshotRing(
+            states=bcast((S, self.ring_depth)),
+            frames=jnp.full((S, self.ring_depth), -1, dtype=jnp.int32),
+            checksums=jnp.zeros((S, self.ring_depth, 2), dtype=jnp.uint32),
+        )
+        # Previous rollout buffers, wholesale-replaced every dispatch.
+        # Placeholder contents are never read: a slot's absorb phase only
+        # selects its row when that slot has pending-rollout metadata.
+        self.prev_states = bcast((S, B))
+        self.prev_rings = SnapshotRing(
+            states=bcast((S, B, F)),
+            frames=jnp.full((S, B, F), -1, dtype=jnp.int32),
+            checksums=jnp.zeros((S, B, F, 2), dtype=jnp.uint32),
+        )
+        self.slots = [_Slot(i) for i in range(S)]
+        self._pending_reports: List[Tuple[object, List[tuple]]] = []
+        zero = input_spec.zeros_np(self.num_players)
+        self._zero = np.asarray(zero)
+        self._zero_bb = np.zeros(
+            (B, F) + self._zero.shape, self._zero.dtype
+        )
+        # Shared all-unknown (known, mask) for sessionless slots: the
+        # builders only read these, and allocating them per slot per tick
+        # was a measured chunk of the S=256 host budget.
+        self._known0 = np.broadcast_to(
+            self._zero, (F,) + self._zero.shape
+        ).copy()
+        self._mask0 = np.zeros((F, self.num_players), dtype=bool)
+        # Aggregate counters (per-slot views go through labeled metrics).
+        self.ticks_total = 0
+        self.device_dispatches_total = 0
+        self.spec_hits = 0
+        self.spec_partial_hits = 0
+        self.spec_misses = 0
+        self.rollbacks_total = 0
+        self.rollback_frames_total = 0
+        self.rollback_frames_recovered_total = 0
+
+    # -- lifecycle ------------------------------------------------------
+
+    @property
+    def active_count(self) -> int:
+        return sum(1 for s in self.slots if s.active)
+
+    def free_slots(self) -> List[int]:
+        return [s.index for s in self.slots if not s.active]
+
+    def warmup(self) -> None:
+        """Compile the batched tick AND the admit program before serving —
+        from here on, match churn must not trigger a compile (the
+        acceptance contract checked against ``compile_counters()``)."""
+        self._dispatch({})
+        row = lambda tree: jax.tree_util.tree_map(lambda x: x[0], tree)
+        # Identity write: row 0 written back onto itself compiles the
+        # admit program without disturbing any occupant.
+        self.rings, self.states = self._exec.admit(
+            self.rings, self.states, 0, row(self.rings), row(self.states)
+        )
+
+    def admit(
+        self,
+        initial_state: Optional[WorldState] = None,
+        slot: Optional[int] = None,
+        spec_on: bool = True,
+    ) -> int:
+        """Place a new match into a free slot (fresh ring + state written
+        on device at a traced index) and return the slot number."""
+        if slot is None:
+            free = self.free_slots()
+            if not free:
+                raise RuntimeError("no free match slots")
+            slot = free[0]
+        s = self.slots[slot]
+        if s.active:
+            raise RuntimeError(f"slot {slot} is occupied")
+        state = (
+            self._template if initial_state is None
+            else jax.tree_util.tree_map(jnp.asarray, initial_state)
+        )
+        self.rings, self.states = self._exec.admit(
+            self.rings, self.states, slot, ring_init(state, self.ring_depth),
+            state,
+        )
+        s.active = True
+        s.frame = 0
+        s.spec_on = bool(spec_on)
+        s.res_anchor = None
+        s.res_bits = None
+        s.res_from_live = True
+        s.native = native_spec.make_spec_builder(
+            self.input_spec, self.num_players, self.num_branches,
+            self.spec_frames, self._branch_values,
+        )
+        s.input_log = (
+            native_spec.MirroredLog(s.native) if s.native is not None else {}
+        )
+        s.shim = _SlotSpecShim(
+            self.input_spec, self.num_players, self.num_branches,
+            self.spec_frames, self._branch_values, s.input_log,
+        )
+        self.metrics.count("matches_admitted")
+        return slot
+
+    def retire(self, slot: int) -> None:
+        """Free a slot. Host-only: the device rows become dead weight until
+        readmission overwrites them — retirement never dispatches, so churn
+        cost is O(1) bookkeeping."""
+        s = self.slots[slot]
+        if not s.active:
+            return
+        # Reports already queued for this slot's session must survive the
+        # retire (they carry their own session refs) — flush now.
+        self.flush_reports()
+        s.active = False
+        s.native = None
+        s.input_log = {}
+        s.shim = None
+        s.res_anchor = None
+        s.res_bits = None
+        self.metrics.count("matches_retired")
+
+    def slot_state(self, slot: int) -> WorldState:
+        """Device view of one slot's live state (e.g. for handing a match
+        back to a singleton runner, or for parity checks)."""
+        return jax.tree_util.tree_map(lambda x: x[slot], self.states)
+
+    def slot_ring(self, slot: int) -> SnapshotRing:
+        return jax.tree_util.tree_map(lambda x: x[slot], self.rings)
+
+    # -- ticking --------------------------------------------------------
+
+    def tick(self, work: Dict[int, tuple]) -> None:
+        """Advance every slot named in ``work`` — ``{slot: (requests,
+        confirmed_frame, session)}`` (``confirmed_frame=None`` means fully
+        confirmed; ``session`` may be None) — in as few batched dispatches
+        as the deepest request list needs (one per Load-delimited segment;
+        the session layer emits single-segment lists, so normally one)."""
+        self.ticks_total += 1
+        self.flush_reports()
+        per_slot: Dict[int, List[tuple]] = {}
+        rounds = 1
+        for slot, (requests, confirmed, session) in work.items():
+            if not self.slots[slot].active:
+                raise RuntimeError(f"slot {slot} is not active")
+            segs = RollbackRunner._segment(None, requests)
+            per_slot[slot] = [
+                (load, steps, confirmed, session) for load, steps in segs
+            ]
+            rounds = max(rounds, len(segs))
+        for r in range(rounds):
+            batch = {
+                slot: segs[r] for slot, segs in per_slot.items()
+                if r < len(segs)
+            }
+            with self.tracer.span("serve_dispatch", round=r):
+                self._dispatch(batch)
+
+    def flush_reports(self) -> None:
+        """Deliver deferred checksum reports (the only device->host sync
+        in the serving loop, off the producing dispatch's critical path)."""
+        if not self._pending_reports:
+            return
+        pending, self._pending_reports = self._pending_reports, []
+        with self.metrics.timer("checksum_sync"):
+            host = [(np.asarray(arr), rows) for arr, rows in pending]
+        for cs_host, rows in host:
+            for slot, t, frame, session in rows:
+                session.report_checksum(frame, combine64(cs_host[slot, t]))
+
+    def _build_branches(self, s: _Slot, anchor: int, end: int, session):
+        """The next rollout's branch tensor for one slot — the singleton
+        builder, verbatim (native when available, else the borrowed
+        structured tree)."""
+        if s.native is not None:
+            qs_ptr = s.native.qset_ptr(session)
+            if qs_ptr is not None:
+                known = known_mask = None
+            elif session is None:
+                known, known_mask = self._known0, self._mask0
+            else:
+                known, known_mask = s.shim._known_inputs(anchor, session)
+            bits, _sig = s.native.build(
+                anchor, qs_ptr, known, known_mask, False, None
+            )
+            return bits
+        last = s.input_log.get(anchor - 1)
+        if last is None:
+            last = self._zero
+        if session is None:
+            known, known_mask = self._known0, self._mask0
+        else:
+            known, known_mask = s.shim._known_inputs(anchor, session)
+        return s.shim._structured_bits(
+            np.asarray(last), known, known_mask, anchor
+        )
+
+    def _dispatch(self, batch: Dict[int, tuple]) -> None:
+        """One vmapped dispatch: slots in ``batch`` run their segment,
+        every other slot no-ops (and, if it has a pending rollout, replays
+        it bitwise so the wholesale prev-buffer swap preserves it)."""
+        S, B, F, MF = (
+            self.num_slots, self.num_branches, self.spec_frames,
+            self.burst_frames,
+        )
+        P = self.num_players
+        i32 = lambda: np.zeros(S, np.int32)
+        branch_a, absorb_first_a, absorb_n_a = i32(), i32(), i32()
+        prev_anchor_a, prev_total_a = i32(), i32()
+        load_frame_a, start_frame_a, spec_anchor_a = i32(), i32(), i32()
+        do_load_a = np.zeros(S, bool)
+        from_live_a = np.ones(S, bool)
+        save_mask_a = np.zeros((S, MF), bool)
+        adv_mask_a = np.zeros((S, MF), bool)
+        bits_a = np.zeros((S, MF) + self._zero.shape, self._zero.dtype)
+        status_a = np.zeros((S, MF, P), np.int32)
+        bb_a = np.zeros((S, B, F) + self._zero.shape, self._zero.dtype)
+        # post[slot] -> state updates applied after the dispatch succeeds
+        post: Dict[int, tuple] = {}
+        reports: List[tuple] = []
+
+        for s in self.slots:
+            i = s.index
+            if i not in batch:
+                # No-op lane: every phase gated off; replay the pending
+                # rollout (if any) so the prev-buffer swap keeps it valid.
+                start_frame_a[i] = s.frame
+                if s.res_anchor is not None:
+                    spec_anchor_a[i] = s.res_anchor
+                    from_live_a[i] = s.res_from_live
+                    bb_a[i] = s.res_bits
+                else:
+                    spec_anchor_a[i] = s.frame
+                continue
+            requests_seg = batch[i]
+            load_frame, steps, confirmed, session = requests_seg
+            start = s.frame if load_frame is None else load_frame
+            if not steps or any(
+                st.adv is None or st.save_frame != start + t
+                for t, st in enumerate(steps)
+            ):
+                raise NotImplementedError(
+                    "batched serving handles the canonical [Load?, (Save, "
+                    "Advance)*] segment only — retire the match to a "
+                    "singleton runner for non-standard bursts"
+                )
+            n_steps = len(steps)
+            if n_steps > MF:
+                raise ValueError(
+                    f"burst of {n_steps} frames exceeds {MF} (slot {i})"
+                )
+            end = start + n_steps
+            anchor = end if confirmed is None else confirmed + 1
+            # As-used log BEFORE match/build (forward-fill reads anchor-1,
+            # which this very burst may advance).
+            for t, st in enumerate(steps):
+                s.input_log[start + t] = np.asarray(st.adv.bits)
+            # Branch-commit decision (host-side, zero device syncs).
+            absorb_branch, n_commit = 0, 0
+            if (
+                load_frame is not None
+                and s.res_anchor is not None
+                and load_frame >= s.res_anchor
+            ):
+                steps_arr = np.stack(
+                    [np.asarray(st.adv.bits) for st in steps]
+                )
+                matched = None
+                if s.native is not None:
+                    matched = s.native.match(
+                        s.res_bits, s.res_anchor, load_frame, steps_arr, F
+                    )
+                else:
+                    needed = []
+                    complete = True
+                    for f in range(s.res_anchor, load_frame):
+                        got = s.input_log.get(f)
+                        if got is None:
+                            complete = False
+                            break
+                        needed.append(got)
+                    if complete:
+                        needed.extend(steps_arr)
+                        matched = match_branch(
+                            s.res_bits, np.stack(needed)[:F]
+                        )
+                if matched is not None:
+                    br, depth = matched
+                    nc = min(depth - (load_frame - s.res_anchor), n_steps)
+                    if nc > 0:
+                        absorb_branch, n_commit = int(br), int(nc)
+                    else:
+                        self.spec_misses += 1
+                        self.metrics.count("spec_misses")
+                        self.metrics.count(
+                            "spec_misses", labels={"match_slot": i}
+                        )
+            # The next rollout. Speculation is active only when the anchor
+            # lies inside the post-burst ring window; otherwise the lane
+            # still computes a (discarded) rollout from the live frontier.
+            spec_active = (
+                s.spec_on and anchor <= end and anchor > end - self.ring_depth
+            )
+            if spec_active:
+                bb = self._build_branches(s, anchor, end, session)
+                spec_anchor, from_live = anchor, (anchor == end)
+            else:
+                bb = self._zero_bb
+                spec_anchor, from_live = end, True
+            # Burst assembly: after a partial commit only the unmatched
+            # tail resimulates, absorb having positioned the state.
+            tail = steps[n_commit:]
+            if n_commit > 0:
+                burst_load, burst_start = None, load_frame + n_commit
+            else:
+                burst_load, burst_start = load_frame, start
+            branch_a[i] = absorb_branch
+            absorb_first_a[i] = load_frame if load_frame is not None else 0
+            absorb_n_a[i] = n_commit
+            prev_anchor_a[i] = s.res_anchor or 0
+            prev_total_a[i] = F if s.res_anchor is not None else 0
+            do_load_a[i] = burst_load is not None
+            load_frame_a[i] = burst_load if burst_load is not None else 0
+            start_frame_a[i] = burst_start
+            n_tail = len(tail)
+            save_mask_a[i, :n_tail] = True
+            adv_mask_a[i, :n_tail] = True
+            for t, st in enumerate(tail):
+                bits_a[i, t] = np.asarray(st.adv.bits)
+                status_a[i, t] = np.asarray(st.adv.status, np.int32)
+            spec_anchor_a[i] = spec_anchor
+            from_live_a[i] = from_live
+            bb_a[i] = bb
+            # bb is per-call fresh from both builders, so storing it for
+            # the replay/match path needs no defensive copy.
+            post[i] = (
+                end, spec_active, anchor if spec_active else None,
+                bb if spec_active else None,
+                from_live, load_frame, n_commit, n_steps, burst_start,
+                n_tail, session,
+            )
+
+        self.device_dispatches_total += 1
+        with self.metrics.timer("serve_dispatch"):
+            (
+                self.rings, self.states, absorb_cs, burst_cs,
+                self.prev_rings, self.prev_states, _spec_cs,
+            ) = self._exec.run(
+                self.rings, self.states, self.prev_rings, self.prev_states,
+                branch_a, absorb_first_a, absorb_n_a, prev_anchor_a,
+                prev_total_a, do_load_a, load_frame_a, start_frame_a,
+                bits_a, status_a, save_mask_a, adv_mask_a,
+                from_live_a, spec_anchor_a, bb_a,
+            )
+
+        for i, (
+            end, spec_active, res_anchor, res_bits, from_live, load_frame,
+            n_commit, n_steps, burst_start, n_tail, session,
+        ) in post.items():
+            s = self.slots[i]
+            s.frame = end
+            if spec_active:
+                s.res_anchor, s.res_bits = res_anchor, res_bits
+                s.res_from_live = from_live
+            else:
+                s.res_anchor, s.res_bits = None, None
+            lab = {"match_slot": i}
+            self.metrics.count("frames_advanced", n_steps)
+            self.metrics.count("frames_advanced", n_steps, labels=lab)
+            if load_frame is not None:
+                self.rollbacks_total += 1
+                self.metrics.count("rollbacks")
+                self.metrics.count("rollbacks", labels=lab)
+                self.metrics.observe("rollback_depth", n_steps)
+                if n_commit > 0:
+                    self.rollback_frames_recovered_total += n_commit
+                    self.metrics.count("rollback_frames_recovered", n_commit)
+                    if n_commit == n_steps:
+                        self.spec_hits += 1
+                        self.metrics.count("spec_hits")
+                        self.metrics.count("spec_hits", labels=lab)
+                    else:
+                        self.spec_partial_hits += 1
+                        self.metrics.count("spec_partial_hits")
+                        self.rollback_frames_total += n_tail
+                        self.metrics.count("rollback_frames", n_tail)
+                else:
+                    self.rollback_frames_total += n_steps
+                    self.metrics.count("rollback_frames", n_steps)
+            if session is not None and self.report_checksums:
+                wants = getattr(session, "wants_checksum", None)
+                rows_a = [
+                    (i, t, load_frame + t) for t in range(n_commit)
+                    if wants is None or wants(load_frame + t)
+                ]
+                rows_b = [
+                    (i, t, burst_start + t) for t in range(n_tail)
+                    if wants is None or wants(burst_start + t)
+                ]
+                if rows_a:
+                    reports.append(
+                        (absorb_cs, [r + (session,) for r in rows_a])
+                    )
+                if rows_b:
+                    reports.append(
+                        (burst_cs, [r + (session,) for r in rows_b])
+                    )
+            self._gc_log(s)
+        self._pending_reports.extend(reports)
+
+    def _gc_log(self, s: _Slot) -> None:
+        horizon = s.frame - self.ring_depth - 64
+        for f in [f for f in s.input_log if f < horizon]:
+            del s.input_log[f]

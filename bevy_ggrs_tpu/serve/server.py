@@ -1,0 +1,200 @@
+"""MatchServer: the host loop that turns batch slots into served matches.
+
+One server = one model family (one schedule, one input spec, one compiled
+batched executable) serving up to ``capacity`` concurrent matches. The
+slots are partitioned into ``stagger_groups`` groups that dispatch at
+evenly spaced offsets across the 16.7 ms frame: with G groups only S/G
+matches' host work (input collection, branch build, argument assembly)
+lands on any one instant, flattening the dispatch burst a single
+all-slots tick would concentrate at frame start. All groups share ONE
+:class:`~bevy_ggrs_tpu.serve.batch.BatchedTickExecutor` — the program is
+compiled once, and the persistent XLA cache
+(:func:`~bevy_ggrs_tpu.utils.xla_cache.ensure_persistent_compilation_cache`)
+makes even that compile a disk read for every process after the first.
+
+Session contract (duck-typed, getattr-guarded — SyncTestSession, P2P and
+spectator sessions all fit):
+
+- ``local_player_handles()`` + ``add_local_input(handle, bits)`` — fed
+  from the match's ``local_inputs(frame, handle)`` callback each frame;
+- ``advance_frame() -> [requests]`` — the canonical request list;
+- ``confirmed_frame()`` (optional) — the speculation anchor; absent means
+  fully confirmed every frame (synctest);
+- ``poll_remote_clients()`` (optional) — pumped before input collection;
+- ``report_checksum(frame, checksum)`` / ``wants_checksum(frame)``
+  (optional) — fed from the core's deferred checksum reports.
+
+Observability: every group dispatch runs under a ``serve_tick`` span and
+per-slot counters carry a ``match_slot`` label; ``slots_active``,
+``slots_free`` and ``last_stagger_jitter_ms`` are live gauges the
+FlightRecorder's ``capture(server=...)`` columns snapshot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional
+
+from bevy_ggrs_tpu.serve.batch import BatchedSessionCore, BatchedTickExecutor
+
+
+@dataclasses.dataclass(frozen=True)
+class MatchHandle:
+    group: int
+    slot: int
+
+
+class _Match:
+    __slots__ = ("session", "local_inputs")
+
+    def __init__(self, session, local_inputs):
+        self.session = session
+        self.local_inputs = local_inputs
+
+
+class MatchServer:
+    def __init__(
+        self,
+        schedule,
+        initial_state,
+        max_prediction: int,
+        num_players: int,
+        input_spec,
+        capacity: int = 64,
+        stagger_groups: int = 4,
+        num_branches: int = 8,
+        spec_frames: Optional[int] = None,
+        branch_values=None,
+        frame_ms: float = 1000.0 / 60.0,
+        metrics=None,
+        tracer=None,
+        clock=time.perf_counter,
+        report_checksums: bool = True,
+    ):
+        from bevy_ggrs_tpu.obs.trace import null_tracer
+        from bevy_ggrs_tpu.utils.metrics import null_metrics
+        from bevy_ggrs_tpu.utils.xla_cache import (
+            ensure_persistent_compilation_cache,
+            install_compile_listeners,
+        )
+
+        ensure_persistent_compilation_cache()
+        install_compile_listeners()
+        self.metrics = metrics if metrics is not None else null_metrics
+        self.tracer = tracer if tracer is not None else null_tracer
+        self.frame_ms = float(frame_ms)
+        self._clock = clock
+        G = max(1, int(stagger_groups))
+        per_group = -(-int(capacity) // G)  # ceil: capacity is a floor
+        self.capacity = per_group * G
+        self._exec = BatchedTickExecutor(
+            schedule, per_group, int(max_prediction) + 2, int(num_branches),
+            int(spec_frames or max_prediction),
+        )
+        self.groups: List[BatchedSessionCore] = [
+            BatchedSessionCore(
+                schedule, initial_state, max_prediction, num_players,
+                input_spec, per_group, num_branches=num_branches,
+                spec_frames=spec_frames, branch_values=branch_values,
+                metrics=self.metrics, tracer=self.tracer,
+                executor=self._exec, report_checksums=report_checksums,
+            )
+            for _ in range(G)
+        ]
+        self._matches: Dict[MatchHandle, _Match] = {}
+        self.frames_served = 0
+        self.last_stagger_jitter_ms: Optional[float] = None
+
+    # -- gauges ---------------------------------------------------------
+
+    @property
+    def slots_active(self) -> int:
+        return sum(g.active_count for g in self.groups)
+
+    @property
+    def slots_free(self) -> int:
+        return self.capacity - self.slots_active
+
+    def cache_size(self) -> int:
+        return self._exec.cache_size()
+
+    # -- lifecycle ------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Compile the shared batched tick + admit programs (one dispatch
+        through group 0 covers every group — they share the executor)."""
+        self.groups[0].warmup()
+
+    def add_match(
+        self,
+        session,
+        local_inputs: Optional[Callable[[int, int], object]] = None,
+        initial_state=None,
+        spec_on: bool = True,
+    ) -> MatchHandle:
+        """Admit a match: its session + a ``local_inputs(frame, handle) ->
+        bits`` callback feeding the session's local handles each frame.
+        Slots balance across stagger groups (least-loaded first)."""
+        group = min(
+            range(len(self.groups)),
+            key=lambda g: (self.groups[g].active_count, g),
+        )
+        core = self.groups[group]
+        if not core.free_slots():
+            raise RuntimeError("server at capacity")
+        slot = core.admit(initial_state=initial_state, spec_on=spec_on)
+        handle = MatchHandle(group, slot)
+        self._matches[handle] = _Match(session, local_inputs)
+        return handle
+
+    def retire_match(self, handle: MatchHandle) -> None:
+        self.groups[handle.group].retire(handle.slot)
+        self._matches.pop(handle, None)
+
+    # -- the frame loop -------------------------------------------------
+
+    def run_frame(self) -> None:
+        """Serve one 60 Hz frame: each stagger group collects its matches'
+        inputs, advances their sessions, and dispatches one batched tick —
+        at its offset within the frame. The loop itself never sleeps (the
+        caller owns pacing, as everywhere in this codebase); the jitter
+        gauge records how far each group's dispatch drifted from its ideal
+        offset given the work that preceded it."""
+        t0 = self._clock()
+        worst_jitter = 0.0
+        by_group: Dict[int, Dict[int, tuple]] = {}
+        for handle, m in self._matches.items():
+            by_group.setdefault(handle.group, {})[handle.slot] = m
+        for g, core in enumerate(self.groups):
+            matches = by_group.get(g)
+            if not matches:
+                continue
+            ideal_off = g * self.frame_ms / len(self.groups)
+            actual_off = (self._clock() - t0) * 1000.0
+            jitter = actual_off - ideal_off
+            worst_jitter = max(worst_jitter, abs(jitter))
+            self.metrics.observe("stagger_jitter", jitter)
+            with self.tracer.span(
+                "serve_tick", group=g, matches=len(matches)
+            ), self.metrics.timer("serve_tick"):
+                work = {}
+                for slot, m in matches.items():
+                    session = m.session
+                    poll = getattr(session, "poll_remote_clients", None)
+                    if poll is not None:
+                        poll()
+                    frame = core.slots[slot].frame
+                    if m.local_inputs is not None:
+                        for h in session.local_player_handles():
+                            session.add_local_input(
+                                h, m.local_inputs(frame, h)
+                            )
+                    requests = session.advance_frame()
+                    conf = getattr(session, "confirmed_frame", None)
+                    confirmed = conf() if conf is not None else None
+                    work[slot] = (requests, confirmed, session)
+                core.tick(work)
+        self.last_stagger_jitter_ms = worst_jitter
+        self.frames_served += 1
+        self.metrics.count("frames_served")

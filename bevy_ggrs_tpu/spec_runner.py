@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import zlib
 from typing import List, Optional
 
@@ -692,10 +693,21 @@ class SpeculativeRollbackRunner(RollbackRunner):
         # run; `speculate()` and the warmup attestation dispatch it too
         # (with unused phases no-op'd), so the program whose states commit
         # is the program that was attested (round-4 verdict weak #2 / #1).
+        # GGRS_SESSION_AXIS=N (conformance mode, N > 0): the fused tick is
+        # vmapped over a broadcast leading session axis inside the same
+        # jitted program, so every existing singleton suite exercises —
+        # and bitwise-verifies — the batched executable that serve/ runs
+        # in production. Singleton semantics are unchanged (slot 0 is
+        # sliced back out). Only honored off-mesh: the session axis and
+        # entity sharding are mutually exclusive (see FusedTickExecutor).
+        session_axis = 0
+        if mesh is None:
+            session_axis = int(os.environ.get("GGRS_SESSION_AXIS", "0") or "0")
         self._fused = FusedTickExecutor(
             schedule, self.executor.max_frames, self.num_branches,
             self.spec_frames, mesh=mesh, branch_axis=branch_axis,
             entity_axis=entity_axis, state_template=self.state,
+            session_axis=session_axis,
         )
         self._key = jax.random.PRNGKey(seed)
         self._result: Optional[SpecResult] = None

@@ -46,6 +46,18 @@ class Timer:
         return False
 
 
+def _labeled(name: str, labels: Optional[Dict[str, object]]) -> str:
+    """Encode a labeled series/counter key in Prometheus exposition form:
+    ``name{k="v",...}`` with keys sorted, so the same label set always maps
+    to the same key and the prom exporter can re-emit it verbatim. Plain
+    (label-less) instruments keep their bare name — zero cost on the
+    existing hot paths."""
+    if not labels:
+        return name
+    body = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return f"{name}{{{body}}}"
+
+
 class Metrics:
     def __init__(self) -> None:
         self.counters: Dict[str, float] = collections.defaultdict(float)
@@ -54,11 +66,17 @@ class Metrics:
 
     # -- instruments ----------------------------------------------------
 
-    def count(self, name: str, n: float = 1) -> None:
-        self.counters[name] += n
+    def count(
+        self, name: str, n: float = 1,
+        labels: Optional[Dict[str, object]] = None,
+    ) -> None:
+        self.counters[_labeled(name, labels)] += n
 
-    def observe(self, name: str, value: float) -> None:
-        s = self.series[name]
+    def observe(
+        self, name: str, value: float,
+        labels: Optional[Dict[str, object]] = None,
+    ) -> None:
+        s = self.series[_labeled(name, labels)]
         s.append(float(value))
         if len(s) > 100_000:  # bound memory on long sessions
             del s[: len(s) // 2]
@@ -130,10 +148,10 @@ class _NullMetrics(Metrics):
     def __init__(self) -> None:  # no dict churn
         pass
 
-    def count(self, name: str, n: float = 1) -> None:
+    def count(self, name: str, n: float = 1, labels=None) -> None:
         pass
 
-    def observe(self, name: str, value: float) -> None:
+    def observe(self, name: str, value: float, labels=None) -> None:
         pass
 
     def timer(self, name: str) -> _NullTimer:  # type: ignore[override]

@@ -55,3 +55,69 @@ def ensure_persistent_compilation_cache(path: Optional[str] = None) -> Optional[
         return cache_dir
     except Exception:
         return None
+
+
+# -- compile counters ---------------------------------------------------
+#
+# Process-wide counts of XLA backend compiles and persistent-cache
+# hits/misses, fed by jax's monitoring events. This is the observable the
+# serving layer's no-recompile-on-churn contract is asserted against:
+# MatchServer admits/retires matches into fixed slots with traced indices,
+# so after warmup `compile_counters()["backend_compiles"]` must not move —
+# tests/test_batched_sessions.py and the serve_batched bench both snapshot
+# it around a churn phase.
+
+_COUNTERS = {
+    "backend_compiles": 0,
+    "cache_tasks": 0,
+    "cache_hits": 0,
+}
+_LISTENERS_INSTALLED = False
+
+
+def install_compile_listeners() -> bool:
+    """Register jax monitoring listeners feeding :func:`compile_counters`.
+
+    Idempotent and process-global (jax's listener registry has no
+    unregister-one API, so installation is once-per-process by design).
+    Returns True when the listeners are live, False when jax is
+    unavailable or too old to expose the monitoring hooks — callers must
+    treat counters as absent then, not as zero compiles.
+    """
+    global _LISTENERS_INSTALLED
+    if _LISTENERS_INSTALLED:
+        return True
+    try:
+        from jax._src import monitoring
+
+        def _on_event(event: str, **kwargs) -> None:
+            # /jax/compilation_cache/tasks_using_cache fires once per jit
+            # task consulting the persistent cache;
+            # .../compile_requests_use_cache fires on a cache HIT (the
+            # request was served from disk instead of a backend compile).
+            if event.endswith("tasks_using_cache"):
+                _COUNTERS["cache_tasks"] += 1
+            elif event.endswith("compile_requests_use_cache"):
+                _COUNTERS["cache_hits"] += 1
+
+        def _on_duration(event: str, duration: float, **kwargs) -> None:
+            # /jax/core/compile/backend_compile_duration fires once per
+            # actual backend (XLA) compile — cache hits don't emit it.
+            if event.endswith("backend_compile_duration"):
+                _COUNTERS["backend_compiles"] += 1
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _LISTENERS_INSTALLED = True
+        return True
+    except Exception:
+        return False
+
+
+def compile_counters() -> dict:
+    """Snapshot of the process-wide compile/cache counters (a copy).
+
+    Zeros until :func:`install_compile_listeners` has been called (and
+    only events after installation are counted — snapshot a baseline and
+    compare deltas)."""
+    return dict(_COUNTERS)
