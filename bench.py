@@ -1870,6 +1870,257 @@ def _serve_batched_case(model: str, S: int) -> dict:
     )
 
 
+# Serve-tier fault domains (serve/faults.py, docs/serving.md "Failure
+# domains"): S synctest matches under injected slot faults — session
+# crashes, watchdog-fenced hangs, and a full server kill-restart from
+# checkpoint. Columns are recovery p50/p99 frames PER FAULT CLASS, the
+# quarantine duty cycle (slot-frames spent off the batch), and the
+# healthy-lane tick-p50 delta vs a fault-free same-process baseline —
+# gated on zero evictions and zero fault-churn recompiles.
+_SERVE_CHAOS_CONFIGS = {"serve_chaos_S64": 64}
+
+
+def _serve_chaos_case(S: int) -> dict:
+    import shutil
+    import tempfile
+
+    from bevy_ggrs_tpu.models import box_game
+    from bevy_ggrs_tpu.serve import MatchServer, SlotHealth
+    from bevy_ggrs_tpu.session.builder import SessionBuilder
+    from bevy_ggrs_tpu.utils import xla_cache
+    from bevy_ggrs_tpu.utils.metrics import Metrics
+
+    P, MAXPRED, B, F = 2, 4, 8, 3
+    ticks = int(os.environ.get("GGRS_SERVE_TICKS", "240") or "240")
+    ticks = max(ticks, 240)
+    kill_at, down_ticks = 160, 12
+    rtt0 = _host_device_rtt_ms()
+    xla_cache.install_compile_listeners()
+
+    def make_synctest():
+        return (
+            SessionBuilder(box_game.INPUT_SPEC)
+            .with_num_players(P)
+            .with_max_prediction_window(MAXPRED)
+            .with_check_distance(2)
+            .start_synctest_session()
+        )
+
+    def inputs_for(seed):
+        def f(frame, handle):
+            return np.uint8((frame * 3 + handle * 5 + seed) % 16)
+
+        return f
+
+    class Flaky:
+        """advance_frame raises exactly once: the 'session crashed'
+        fault class."""
+
+        def __init__(self, inner, fail_at):
+            self._inner, self._fail_at, self.failed = inner, fail_at, False
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def advance_frame(self):
+            if not self.failed and self._inner.current_frame == self._fail_at:
+                self.failed = True
+                raise RuntimeError("injected session crash")
+            return self._inner.advance_frame()
+
+    class Hung:
+        """Burns fake-clock time inside advance_frame for a window of
+        frames: the watchdog-fenced fault class."""
+
+        def __init__(self, inner, clk, hang_frames, hang_s=0.2):
+            self._inner, self._clk = inner, clk
+            self._hang = set(hang_frames)
+            self._hang_s = hang_s
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def advance_frame(self):
+            if self._inner.current_frame in self._hang:
+                self._clk[0] += self._hang_s
+            return self._inner.advance_frame()
+
+    ckpt_dir = tempfile.mkdtemp(prefix="ggrs_serve_chaos_")
+    clk = [0.0]
+    flaky = {3: 40, 17: 55, 33: 70}  # match -> frame its session crashes
+    hung = {9: range(100, 103), 46: range(101, 104)}  # -> hang window
+
+    def build(metrics):
+        server = MatchServer(
+            box_game.make_schedule(), box_game.make_world(P).commit(),
+            MAXPRED, P, box_game.INPUT_SPEC,
+            num_branches=B, spec_frames=F, capacity=S, stagger_groups=4,
+            metrics=metrics, clock=lambda: clk[0],
+            watchdog_budget_ms=50.0, watchdog_strike_limit=3,
+            checkpoint_dir=ckpt_dir, checkpoint_interval=60,
+            checkpoint_keep=3,
+        )
+        server.warmup()
+        return server
+
+    def run(chaos):
+        clk[0] = 0.0
+        for f in os.listdir(ckpt_dir):
+            os.unlink(os.path.join(ckpt_dir, f))
+        metrics = Metrics()
+        server = build(metrics)
+        handle_of = {}
+        for m in range(S):
+            sess = make_synctest()
+            if chaos and m in flaky:
+                sess = Flaky(sess, flaky[m])
+            elif chaos and m in hung:
+                sess = Hung(sess, clk, hung[m])
+            handle_of[m] = server.add_match(sess, inputs_for(m))
+        compiles_seg = xla_cache.compile_counters()["backend_compiles"]
+        churn_recompiles = 0
+        times = []  # (tick_ms, active_lane_count)
+        per_class = {}
+        prev_lanes, prev_obs = set(), 0
+        pre_kill = {}
+        for t in range(ticks):
+            if chaos and t == kill_at:
+                # kill -9: the process is gone mid-fleet. The rebuild's
+                # own warmup compiles are NOT fault-churn — segment the
+                # compile counter around it.
+                pre_kill = {
+                    (e["handle"].group, e["handle"].slot): e["frame"]
+                    for e in server.snapshot_matches()
+                }
+                churn_recompiles += (
+                    xla_cache.compile_counters()["backend_compiles"]
+                    - compiles_seg
+                )
+                server = None
+            if server is None:
+                if t == kill_at + down_ticks:
+                    server = build(metrics)
+                    server.checkpointer.restore(
+                        server,
+                        {
+                            (h.group, h.slot): {
+                                "session": make_synctest(),
+                                "local_inputs": inputs_for(m),
+                            }
+                            for m, h in handle_of.items()
+                        },
+                    )
+                    compiles_seg = xla_cache.compile_counters()[
+                        "backend_compiles"
+                    ]
+                    prev_lanes, prev_obs = set(), len(
+                        metrics.series.get("slot_recovery_frames", ())
+                    )
+                    # Per-match recovery debt: checkpoint replay distance
+                    # plus the frames the server spent dead.
+                    post = {
+                        (e["handle"].group, e["handle"].slot): e["frame"]
+                        for e in server.snapshot_matches()
+                    }
+                    per_class["server_kill_restart"] = [
+                        float(pre_kill[k] - post[k] + down_ticks)
+                        for k in pre_kill
+                    ]
+                else:
+                    clk[0] += 1.0 / 60.0
+                    continue
+            t0 = time.perf_counter()
+            server.run_frame()
+            for core in server.groups:
+                jax.block_until_ready(core.states)
+            times.append(
+                ((time.perf_counter() - t0) * 1000.0, len(server._lanes))
+            )
+            # Attribute fresh readmissions to their fault class (the FSM
+            # keeps last_reason across the HEALTHY transition).
+            cur = set(server._lanes)
+            obs = metrics.series.get("slot_recovery_frames", ())
+            if len(obs) > prev_obs:
+                fresh = [
+                    h for h in prev_lanes - cur if h in server._matches
+                ]
+                for h, v in zip(fresh, obs[prev_obs:]):
+                    reason = server._matches[h].fsm.last_reason
+                    per_class.setdefault(reason, []).append(float(v))
+                prev_obs = len(obs)
+            prev_lanes = cur
+            clk[0] += 1.0 / 60.0
+        churn_recompiles += (
+            xla_cache.compile_counters()["backend_compiles"] - compiles_seg
+        )
+        return server, metrics, times, per_class, churn_recompiles
+
+    try:
+        base_server, _, base_times, _, _ = run(chaos=False)
+        del base_server
+        server, metrics, times, per_class, churn_recompiles = run(chaos=True)
+
+        healthy = [ms for ms, lanes in times if lanes == 0]
+        fenced = [ms for ms, lanes in times if lanes > 0]
+        base = [ms for ms, _ in base_times]
+        base_p50 = float(np.percentile(base, 50))
+        healthy_p50 = float(np.percentile(healthy, 50))
+        lane_slot_frames = sum(lanes for _, lanes in times)
+        duty = lane_slot_frames / float(S * len(times))
+        all_healthy = all(
+            server.health_of(h) is SlotHealth.HEALTHY
+            for h in server._matches
+        )
+        recovery_cols = {}
+        for reason, vals in sorted(per_class.items()):
+            recovery_cols[f"recovery_p50_frames_{reason}"] = float(
+                np.percentile(vals, 50)
+            )
+            recovery_cols[f"recovery_p99_frames_{reason}"] = float(
+                np.percentile(vals, 99)
+            )
+            recovery_cols[f"recovery_events_{reason}"] = len(vals)
+        return _entry(
+            f"serve_chaos_S{S}",
+            healthy_p50, S, B,
+            rtt_ms=rtt0,
+            sessions=S,
+            model="box_game",
+            ticks=len(times),
+            tick_p50_healthy_ms=round(healthy_p50, 4),
+            tick_p50_fault_window_ms=round(
+                float(np.percentile(fenced, 50)), 4
+            ) if fenced else None,
+            baseline_tick_p50_ms=round(base_p50, 4),
+            healthy_tick_delta_ms=round(healthy_p50 - base_p50, 4),
+            quarantine_duty_cycle=round(duty, 6),
+            # From the shared metrics, not the server object: the server
+            # instance (and its counters) was rebuilt at the kill.
+            faults_total=int(metrics.counters.get("slot_faults", 0)),
+            readmissions_total=int(
+                metrics.counters.get("slot_readmissions", 0)
+            ),
+            evictions_total=int(metrics.counters.get("slot_evictions", 0)),
+            all_slots_healthy=bool(all_healthy),
+            churn_recompiles=int(churn_recompiles),
+            **recovery_cols,
+            notes=(
+                "3 session crashes + 2 watchdog-fenced hangs + 1 server "
+                "kill-restart (checkpoint interval 60f, 12f downtime) over "
+                f"{len(times)} driven frames; per-class recovery is frames "
+                "from fault to bitwise readmission (kill-restart: "
+                "checkpoint replay debt + downtime); gated on zero "
+                "evictions and churn_recompiles == 0 (rebuild warmup "
+                "compiles are segmented out); the healthy-tick delta runs "
+                "baseline-then-chaos in ONE process, so same-process "
+                "allocator drift rides on it (see the header note) — read "
+                "it as an upper bound"
+            ),
+        )
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
 # _cpuhost variants force the CPU backend (a LOCAL device): they
 # demonstrate the framework's host path meets the render deadline when
 # dispatch isn't tunnel-bound — the fair live reading for this
@@ -1909,6 +2160,8 @@ def run_config(name: str) -> dict:
     if name in _SERVE_CONFIGS:
         model, S = _SERVE_CONFIGS[name]
         return _serve_batched_case(model, S)
+    if name in _SERVE_CHAOS_CONFIGS:
+        return _serve_chaos_case(_SERVE_CHAOS_CONFIGS[name])
     if name in _LIVE_CONFIGS:
         model, speculate, transport = _LIVE_CONFIGS[name]
         rtt0 = _host_device_rtt_ms()
@@ -1933,7 +2186,7 @@ def run_matrix() -> list:
     for name in (list(_CONFIGS) + list(_RECOVERY_CONFIGS)
                  + list(_LIVE_CONFIGS) + list(_EIGHTP_CONFIGS)
                  + list(_MULTIHOST_CONFIGS) + list(_RELAY_CONFIGS)
-                 + list(_SERVE_CONFIGS)):
+                 + list(_SERVE_CONFIGS) + list(_SERVE_CHAOS_CONFIGS)):
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--config", name],
             capture_output=True, text=True, cwd=os.path.dirname(
@@ -2009,7 +2262,7 @@ def main() -> None:
         valid = (list(_CONFIGS) + list(_RECOVERY_CONFIGS)
                  + list(_LIVE_CONFIGS) + list(_EIGHTP_CONFIGS)
                  + list(_MULTIHOST_CONFIGS) + list(_RELAY_CONFIGS)
-                 + list(_SERVE_CONFIGS))
+                 + list(_SERVE_CONFIGS) + list(_SERVE_CHAOS_CONFIGS))
         if idx >= len(args) or args[idx] not in valid:
             print(f"bench: --config needs one of: {', '.join(valid)}",
                   file=sys.stderr)

@@ -19,6 +19,7 @@ from bevy_ggrs_tpu.chaos.plan import (
     Partition,
     RelayKillRestart,
     Reorder,
+    ServerKillRestart,
 )
 from bevy_ggrs_tpu.chaos.socket import ChaosSocket
 
@@ -32,4 +33,5 @@ __all__ = [
     "Partition",
     "RelayKillRestart",
     "Reorder",
+    "ServerKillRestart",
 ]

@@ -100,9 +100,28 @@ class RelayKillRestart:
     down_for: float
 
 
+@dataclasses.dataclass(frozen=True)
+class ServerKillRestart:
+    """Script a MATCH-SERVER process death: the :class:`~bevy_ggrs_tpu.
+    serve.server.MatchServer` identified by ``server`` dies (kill -9 — no
+    flush, no farewell) at ``at`` and may be restarted from its last
+    on-disk checkpoint ``down_for`` seconds later. Like
+    :class:`KillRestart` and :class:`RelayKillRestart`, the socket layer
+    ignores it — the harness drops the server object at ``at`` and
+    rebuilds it after the window via ``ServerCheckpointer.restore``
+    (synctest matches resume bitwise from the checkpoint; P2P matches
+    rejoin through the supervisor's crash-restart path; see
+    tests/test_serve_chaos.py). Carrying it in the plan keeps the whole
+    serve-tier failure script in one replayable artifact."""
+
+    at: float
+    server: object
+    down_for: float
+
+
 Directive = Union[
     LossBurst, Reorder, Duplicate, Corrupt, Partition, KillRestart,
-    RelayKillRestart,
+    RelayKillRestart, ServerKillRestart,
 ]
 
 _KINDS = {
@@ -113,6 +132,7 @@ _KINDS = {
     "partition": Partition,
     "kill_restart": KillRestart,
     "relay_kill_restart": RelayKillRestart,
+    "server_kill_restart": ServerKillRestart,
 }
 _NAMES = {cls: name for name, cls in _KINDS.items()}
 
@@ -167,6 +187,12 @@ class ChaosPlan:
             key=lambda d: d.at,
         )
 
+    def server_kill_restarts(self) -> List[ServerKillRestart]:
+        return sorted(
+            (d for d in self.directives if isinstance(d, ServerKillRestart)),
+            key=lambda d: d.at,
+        )
+
     def horizon(self) -> float:
         """Time at which the last directive has expired/healed."""
         t = 0.0
@@ -174,7 +200,9 @@ class ChaosPlan:
             t = max(
                 t,
                 d.at + d.down_for
-                if isinstance(d, (KillRestart, RelayKillRestart))
+                if isinstance(
+                    d, (KillRestart, RelayKillRestart, ServerKillRestart)
+                )
                 else d.end,
             )
         return t
@@ -188,7 +216,7 @@ class ChaosPlan:
             for f in dataclasses.fields(d):
                 v = getattr(d, f.name)
                 entry[f.name] = _addr_to_json(v) if f.name in (
-                    "src", "dst", "peer", "relay"
+                    "src", "dst", "peer", "relay", "server"
                 ) else v
             out.append(entry)
         return json.dumps({"seed": self.seed, "directives": out}, indent=2)
@@ -200,7 +228,7 @@ class ChaosPlan:
         for entry in raw["directives"]:
             entry = dict(entry)
             kind = _KINDS[entry.pop("kind")]
-            for k in ("src", "dst", "peer", "relay"):
+            for k in ("src", "dst", "peer", "relay", "server"):
                 if k in entry:
                     entry[k] = _addr_from_json(entry[k])
             directives.append(kind(**entry))
@@ -216,13 +244,16 @@ class ChaosPlan:
         peers: Tuple[object, ...] = (),
         kill_restart: bool = False,
         relay: Optional[object] = None,
+        match_server: Optional[object] = None,
     ) -> "ChaosPlan":
         """A deterministic mixed-fault schedule over ``duration`` seconds:
         a few loss bursts, one reorder window, one duplication window, one
         light corruption window, one asymmetric partition with a heal
-        window, (opt-in) one peer kill/restart, and — when ``relay`` names
-        a relay address — one scripted relay kill/restart. Same ``(seed,
-        duration, peers, relay)`` -> same plan, always."""
+        window, (opt-in) one peer kill/restart, when ``relay`` names a
+        relay address one scripted relay kill/restart, and — when
+        ``match_server`` names a serve-tier process — one scripted
+        :class:`ServerKillRestart`. Same ``(seed, duration, peers, relay,
+        match_server)`` -> same plan, always."""
         rng = np.random.RandomState(seed & 0x7FFFFFFF)
         span = max(float(duration), 1.0)
         d: List[Directive] = []
@@ -252,4 +283,11 @@ class ChaosPlan:
             t0 = float(rng.uniform(0.3 * span, 0.55 * span))
             d.append(RelayKillRestart(t0, relay,
                                       float(rng.uniform(0.03, 0.06) * span)))
+        if match_server is not None:
+            # Late in the run, after every network-fault window has had a
+            # chance to open — a server crash layered onto an already-noisy
+            # match is the shape the checkpoint/rejoin path must survive.
+            t0 = float(rng.uniform(0.55 * span, 0.75 * span))
+            d.append(ServerKillRestart(t0, match_server,
+                                       float(rng.uniform(0.04, 0.08) * span)))
         return cls(seed, tuple(d))

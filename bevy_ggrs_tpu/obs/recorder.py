@@ -56,6 +56,9 @@ class FrameRecord:
     slots_active: Optional[int] = None
     slots_free: Optional[int] = None
     stagger_jitter_ms: Optional[float] = None
+    # Serve-tier fault-domain gauges (None outside a MatchServer loop).
+    slots_quarantined: Optional[int] = None
+    slots_recovering: Optional[int] = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -139,6 +142,7 @@ class FlightRecorder:
 
         slots_active = slots_free = None
         stagger_jitter = None
+        slots_quarantined = slots_recovering = None
         if server is not None:
             # MatchServer (or anything exposing the same gauges): slot
             # occupancy + how far the stagger-group dispatches drifted off
@@ -147,6 +151,10 @@ class FlightRecorder:
             slots_free = int(getattr(server, "slots_free", 0))
             jitter = getattr(server, "last_stagger_jitter_ms", None)
             stagger_jitter = None if jitter is None else float(jitter)
+            q = getattr(server, "slots_quarantined", None)
+            slots_quarantined = None if q is None else int(q)
+            r = getattr(server, "slots_recovering", None)
+            slots_recovering = None if r is None else int(r)
 
         health = None
         transition = None
@@ -179,6 +187,8 @@ class FlightRecorder:
             slots_active=slots_active,
             slots_free=slots_free,
             stagger_jitter_ms=stagger_jitter,
+            slots_quarantined=slots_quarantined,
+            slots_recovering=slots_recovering,
         )
         self._seq += 1
         self.records.append(rec)

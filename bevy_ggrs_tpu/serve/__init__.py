@@ -6,10 +6,21 @@
 speculation host logic). ``serve.server`` drives it:
 :class:`MatchServer` multiplexes per-match sessions into slots, staggers
 group dispatches across the frame, and exposes the occupancy/jitter gauges
-the flight recorder captures.
+the flight recorder captures. ``serve.faults`` is the containment layer:
+typed :class:`SlotFault`, the per-slot :class:`SlotHealthFSM`, singleton
+:class:`RecoveryLane` drains, and :class:`ServerCheckpointer` crash-restart
+(docs/serving.md "Failure domains").
 """
 
 from bevy_ggrs_tpu.serve.batch import BatchedSessionCore, BatchedTickExecutor
+from bevy_ggrs_tpu.serve.faults import (
+    RecoveryLane,
+    ServerCheckpointer,
+    SlotFault,
+    SlotHealth,
+    SlotHealthFSM,
+    SlotTicket,
+)
 from bevy_ggrs_tpu.serve.server import MatchHandle, MatchServer
 
 __all__ = [
@@ -17,4 +28,10 @@ __all__ = [
     "BatchedTickExecutor",
     "MatchHandle",
     "MatchServer",
+    "RecoveryLane",
+    "ServerCheckpointer",
+    "SlotFault",
+    "SlotHealth",
+    "SlotHealthFSM",
+    "SlotTicket",
 ]

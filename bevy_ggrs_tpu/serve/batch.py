@@ -64,6 +64,8 @@ from bevy_ggrs_tpu.native import spec as native_spec
 from bevy_ggrs_tpu.parallel.speculate import match_branch
 from bevy_ggrs_tpu.runner import RollbackRunner, _Step
 from bevy_ggrs_tpu.schedule import PREDICTED, Schedule
+from bevy_ggrs_tpu.serve.faults import SlotFault, SlotTicket
+from bevy_ggrs_tpu.session.requests import RestoreGameState
 from bevy_ggrs_tpu.spec_runner import SpeculativeRollbackRunner
 from bevy_ggrs_tpu.state import SnapshotRing, WorldState, combine64, ring_init
 
@@ -201,10 +203,12 @@ class BatchedSessionCore:
     The per-slot request protocol matches the singleton runner's canonical
     tick: each slot submits one ``[Load?, (Save, Advance)*]`` segment per
     round with saves labeled contiguously (the session layer produces
-    exactly this shape). ``RestoreGameState`` and non-standard bursts are
-    rejected — a match needing supervisor state transfer must be retired
-    to a singleton runner (slot state is extractable via
-    :meth:`slot_state`).
+    exactly this shape). ``RestoreGameState`` and non-standard bursts
+    raise a typed :class:`~bevy_ggrs_tpu.serve.faults.SlotFault` naming
+    the offending slot — BEFORE any slot's host or device state is touched
+    (every segment of every slot is validated ahead of the apply loop), so
+    the server can drop the faulted slot, re-tick the rest, and drain the
+    match to a singleton recovery lane via :meth:`extract`.
 
     Determinism-per-slot: every slot's committed trajectory is computed by
     the same vmapped executable regardless of what other slots are doing
@@ -338,9 +342,19 @@ class BatchedSessionCore:
         initial_state: Optional[WorldState] = None,
         slot: Optional[int] = None,
         spec_on: bool = True,
+        ticket: Optional[SlotTicket] = None,
     ) -> int:
-        """Place a new match into a free slot (fresh ring + state written
-        on device at a traced index) and return the slot number."""
+        """Place a match into a free slot and return the slot number.
+
+        Fresh admission writes ``ring_init(state)`` + ``state`` on device
+        at a traced index. Passing ``ticket`` instead READMITS a drained
+        match mid-trajectory: the ticket's whole ring and live state go
+        through the SAME traced-index admit program (identical shapes —
+        singleton rings share the ``max_prediction + 1`` depth — so zero
+        recompiles), the frame counter resumes where the ticket left off,
+        and the fresh per-slot input log / native builder is seeded from
+        the ticket's log tail so the next speculation round builds from
+        the same history a singleton would."""
         if slot is None:
             free = self.free_slots()
             if not free:
@@ -349,17 +363,27 @@ class BatchedSessionCore:
         s = self.slots[slot]
         if s.active:
             raise RuntimeError(f"slot {slot} is occupied")
-        state = (
-            self._template if initial_state is None
-            else jax.tree_util.tree_map(jnp.asarray, initial_state)
-        )
+        if ticket is not None:
+            depth = int(ticket.ring.frames.shape[0])
+            if depth != self.ring_depth:
+                raise ValueError(
+                    f"ticket ring depth {depth} != core depth "
+                    f"{self.ring_depth} (mismatched max_prediction)"
+                )
+            new_ring = ticket.ring
+            state = jax.tree_util.tree_map(jnp.asarray, ticket.state)
+        else:
+            state = (
+                self._template if initial_state is None
+                else jax.tree_util.tree_map(jnp.asarray, initial_state)
+            )
+            new_ring = ring_init(state, self.ring_depth)
         self.rings, self.states = self._exec.admit(
-            self.rings, self.states, slot, ring_init(state, self.ring_depth),
-            state,
+            self.rings, self.states, slot, new_ring, state,
         )
         s.active = True
-        s.frame = 0
-        s.spec_on = bool(spec_on)
+        s.frame = 0 if ticket is None else int(ticket.frame)
+        s.spec_on = bool(spec_on if ticket is None else ticket.spec_on)
         s.res_anchor = None
         s.res_bits = None
         s.res_from_live = True
@@ -370,11 +394,18 @@ class BatchedSessionCore:
         s.input_log = (
             native_spec.MirroredLog(s.native) if s.native is not None else {}
         )
+        if ticket is not None and ticket.input_log:
+            # MirroredLog.update forwards into the native builder's C++
+            # mirror, so readmitted slots rank/fingerprint from the same
+            # history either way.
+            s.input_log.update(ticket.input_log)
         s.shim = _SlotSpecShim(
             self.input_spec, self.num_players, self.num_branches,
             self.spec_frames, self._branch_values, s.input_log,
         )
-        self.metrics.count("matches_admitted")
+        self.metrics.count(
+            "matches_admitted" if ticket is None else "matches_readmitted"
+        )
         return slot
 
     def retire(self, slot: int) -> None:
@@ -403,14 +434,54 @@ class BatchedSessionCore:
     def slot_ring(self, slot: int) -> SnapshotRing:
         return jax.tree_util.tree_map(lambda x: x[slot], self.rings)
 
+    def extract(self, slot: int) -> SlotTicket:
+        """Drain a slot: snapshot its full trajectory state into a
+        :class:`SlotTicket` (device views are snapshots — later dispatches
+        never mutate them) and retire the slot. The ticket seeds a
+        singleton recovery runner (``faults.adopt_ticket``) and later
+        readmits via ``admit(ticket=...)``, bitwise-continuous."""
+        s = self.slots[slot]
+        if not s.active:
+            raise RuntimeError(f"slot {slot} is not active")
+        ticket = SlotTicket(
+            frame=int(s.frame),
+            state=self.slot_state(slot),
+            ring=self.slot_ring(slot),
+            input_log=dict(s.input_log),
+            spec_on=bool(s.spec_on),
+        )
+        self.retire(slot)
+        return ticket
+
     # -- ticking --------------------------------------------------------
+
+    def _validate_segment(
+        self, slot: int, frame: int, load_frame: Optional[int], steps
+    ) -> int:
+        """Canonical-shape check for one segment, BEFORE anything mutates:
+        raises :class:`SlotFault` instead of half-applying a round.
+        Returns the frame the slot would reach."""
+        start = frame if load_frame is None else load_frame
+        if not steps or any(
+            st.adv is None or st.save_frame != start + t
+            for t, st in enumerate(steps)
+        ):
+            raise SlotFault(slot, "non_canonical_burst", frame)
+        if len(steps) > self.burst_frames:
+            raise SlotFault(slot, "burst_overflow", frame)
+        return start + len(steps)
 
     def tick(self, work: Dict[int, tuple]) -> None:
         """Advance every slot named in ``work`` — ``{slot: (requests,
         confirmed_frame, session)}`` (``confirmed_frame=None`` means fully
         confirmed; ``session`` may be None) — in as few batched dispatches
         as the deepest request list needs (one per Load-delimited segment;
-        the session layer emits single-segment lists, so normally one)."""
+        the session layer emits single-segment lists, so normally one).
+
+        Fault atomicity: every slot's every segment (all rounds) is
+        validated up front, so a :class:`SlotFault` escaping this method
+        guarantees NO slot's state — host or device — changed. The caller
+        may drop the named slot from ``work`` and call again."""
         self.ticks_total += 1
         self.flush_reports()
         per_slot: Dict[int, List[tuple]] = {}
@@ -418,7 +489,18 @@ class BatchedSessionCore:
         for slot, (requests, confirmed, session) in work.items():
             if not self.slots[slot].active:
                 raise RuntimeError(f"slot {slot} is not active")
-            segs = RollbackRunner._segment(None, requests)
+            frame = self.slots[slot].frame
+            try:
+                segs = RollbackRunner._segment(None, requests)
+            except TypeError as e:
+                reason = (
+                    "restore_request"
+                    if any(isinstance(r, RestoreGameState) for r in requests)
+                    else "unsupported_request"
+                )
+                raise SlotFault(slot, reason, frame, cause=e) from e
+            for load, steps in segs:
+                frame = self._validate_segment(slot, frame, load, steps)
             per_slot[slot] = [
                 (load, steps, confirmed, session) for load, steps in segs
             ]
@@ -473,12 +555,19 @@ class BatchedSessionCore:
     def _dispatch(self, batch: Dict[int, tuple]) -> None:
         """One vmapped dispatch: slots in ``batch`` run their segment,
         every other slot no-ops (and, if it has a pending rollout, replays
-        it bitwise so the wholesale prev-buffer swap preserves it)."""
+        it bitwise so the wholesale prev-buffer swap preserves it).
+
+        Atomic on fault: segments are re-validated in a pre-pass (direct
+        callers may bypass :meth:`tick`), so a raise can only happen before
+        the first input-log write or device dispatch — a sibling slot's
+        next-tick output is bitwise unaffected by another slot faulting."""
         S, B, F, MF = (
             self.num_slots, self.num_branches, self.spec_frames,
             self.burst_frames,
         )
         P = self.num_players
+        for i, (load_frame, steps, _confirmed, _session) in batch.items():
+            self._validate_segment(i, self.slots[i].frame, load_frame, steps)
         i32 = lambda: np.zeros(S, np.int32)
         branch_a, absorb_first_a, absorb_n_a = i32(), i32(), i32()
         prev_anchor_a, prev_total_a = i32(), i32()
@@ -510,20 +599,7 @@ class BatchedSessionCore:
             requests_seg = batch[i]
             load_frame, steps, confirmed, session = requests_seg
             start = s.frame if load_frame is None else load_frame
-            if not steps or any(
-                st.adv is None or st.save_frame != start + t
-                for t, st in enumerate(steps)
-            ):
-                raise NotImplementedError(
-                    "batched serving handles the canonical [Load?, (Save, "
-                    "Advance)*] segment only — retire the match to a "
-                    "singleton runner for non-standard bursts"
-                )
             n_steps = len(steps)
-            if n_steps > MF:
-                raise ValueError(
-                    f"burst of {n_steps} frames exceeds {MF} (slot {i})"
-                )
             end = start + n_steps
             anchor = end if confirmed is None else confirmed + 1
             # As-used log BEFORE match/build (forward-fill reads anchor-1,

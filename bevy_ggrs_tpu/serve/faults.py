@@ -1,0 +1,659 @@
+"""Serve-tier fault domains: per-slot blast-radius containment.
+
+PR 7's batched core made S matches share one compiled executable — and one
+fault domain: a single slot whose session stalls, raises, or needs a
+supervisor state transfer could poison the dispatch serving the other S−1.
+This module is the containment layer the ROADMAP's "PR-1 moment for the
+serve tier" asks for, in the Podracer spirit (PAPERS.md [3]): at fleet
+scale preemption and peer failure are the steady state, so the design
+target is isolation + fast recovery, not absence of faults.
+
+The pieces, in blast-radius order:
+
+- :class:`SlotFault` — the typed escape hatch replacing the batched core's
+  blanket rejections. Raised BEFORE any sibling-slot state is mutated
+  (``BatchedSessionCore`` pre-validates every segment of every slot ahead
+  of the apply loop), so catching it and retrying the round without the
+  faulted slot is always safe.
+- :class:`SlotHealthFSM` — per-slot ``HEALTHY → DEGRADED → QUARANTINED →
+  RECOVERING → (HEALTHY | EVICTED)`` with a legal-transition table, watchdog
+  strike counting, and a traced edge per transition (mirroring the
+  supervisor's ``_set_health`` idiom).
+- :class:`SlotTicket` — the portable form of one match's device state
+  (frame, world, full snapshot ring, as-used input-log tail, speculation
+  flag). Extraction (``BatchedSessionCore.extract``) and readmission
+  (``admit(ticket=...)``) both move the WHOLE ring, because synctest
+  sessions issue ``LoadGameState(frame - check_distance)`` every frame —
+  a readmitted slot with an empty ring would fault again immediately.
+- :class:`RecoveryLane` — a singleton :class:`~bevy_ggrs_tpu.runner.
+  RollbackRunner` driving one drained match off the hot batch path,
+  optionally under the existing :class:`~bevy_ggrs_tpu.session.supervisor.
+  SessionSupervisor` (desync ballots, type-9/10 state transfer, crash
+  rejoin). All lanes of a server share ONE warmed
+  :class:`~bevy_ggrs_tpu.rollout.RolloutExecutor`, so draining and
+  readmitting matches keeps the compile-counter delta at zero — the same
+  churn contract the batched admit program honors.
+- :class:`ServerCheckpointer` — periodic per-slot checkpoints through the
+  relay tier's :class:`~bevy_ggrs_tpu.relay.delta.StateCodec` flat-byte
+  layout (plus input-log tails), so a killed MatchServer process restarts
+  and re-seeds every occupied slot: synctest matches resume bitwise from
+  the checkpoint, P2P matches rejoin through the supervisor's
+  crash-restart path (docs/serving.md "Failure domains").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+import re
+import tempfile
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bevy_ggrs_tpu.session.common import PredictionThreshold, SessionState
+from bevy_ggrs_tpu.session.supervisor import Health
+
+__all__ = [
+    "SlotHealth",
+    "SlotFault",
+    "SlotHealthFSM",
+    "SlotTicket",
+    "RecoveryLane",
+    "ServerCheckpointer",
+]
+
+
+class SlotHealth(enum.Enum):
+    HEALTHY = "healthy"
+    DEGRADED = "degraded"  # watchdog strikes accumulating; still batched
+    QUARANTINED = "quarantined"  # fenced off the batch; lane being built
+    RECOVERING = "recovering"  # advancing on a singleton recovery lane
+    EVICTED = "evicted"  # recovery deadline blown; match removed
+
+
+# Legal edges. HEALTHY -> QUARANTINED is direct (a raise needs no strike
+# warm-up); HEALTHY -> RECOVERING covers crash-restart adoption, where a
+# restarted server readmits a P2P match straight into a rejoin lane.
+_LEGAL: Dict[SlotHealth, frozenset] = {
+    SlotHealth.HEALTHY: frozenset(
+        {SlotHealth.DEGRADED, SlotHealth.QUARANTINED, SlotHealth.RECOVERING}
+    ),
+    SlotHealth.DEGRADED: frozenset(
+        {SlotHealth.HEALTHY, SlotHealth.QUARANTINED}
+    ),
+    SlotHealth.QUARANTINED: frozenset(
+        {SlotHealth.RECOVERING, SlotHealth.EVICTED}
+    ),
+    SlotHealth.RECOVERING: frozenset(
+        {SlotHealth.HEALTHY, SlotHealth.EVICTED}
+    ),
+    SlotHealth.EVICTED: frozenset(),
+}
+
+
+class SlotFault(RuntimeError):
+    """One slot's tick cannot proceed. Carries enough to fence exactly that
+    slot: which slot, why, and at what frame. The batched core guarantees
+    that when this escapes, NO slot's host or device state was mutated by
+    the aborted round — the server drops the faulted slot from the work
+    dict and re-ticks the rest, handing the dropped ``(requests, session)``
+    to the recovery lane so the session's frame counter and the runner
+    never disagree (the ggrs save-frame invariant)."""
+
+    def __init__(
+        self,
+        slot: int,
+        reason: str,
+        frame: int,
+        cause: Optional[BaseException] = None,
+    ):
+        super().__init__(
+            f"slot {slot} faulted at frame {frame}: {reason}"
+            + (f" ({cause!r})" if cause is not None else "")
+        )
+        self.slot = int(slot)
+        self.reason = str(reason)
+        self.frame = int(frame)
+        self.cause = cause
+
+
+class SlotHealthFSM:
+    """Health state for one served match, with validated transitions.
+
+    Watchdog integration: :meth:`strike` records one over-budget host tick
+    (``HEALTHY -> DEGRADED`` on the first, ``-> QUARANTINED`` — returning
+    True — at ``strike_limit``); :meth:`clear` forgives the streak once a
+    tick lands back inside its budget. Every edge emits a tracer instant
+    and a labeled metric so the flight recorder can reconstruct the full
+    quarantine timeline per ``match_slot``.
+    """
+
+    def __init__(
+        self,
+        slot: int,
+        metrics=None,
+        tracer=None,
+        strike_limit: int = 3,
+        initial: SlotHealth = SlotHealth.HEALTHY,
+    ):
+        from bevy_ggrs_tpu.obs.trace import null_tracer
+        from bevy_ggrs_tpu.utils.metrics import null_metrics
+
+        self.slot = int(slot)
+        self.metrics = metrics if metrics is not None else null_metrics
+        self.tracer = tracer if tracer is not None else null_tracer
+        self.strike_limit = int(strike_limit)
+        self.state = initial
+        self.strikes = 0
+        self.last_reason: Optional[str] = None
+        self.last_fault_frame: Optional[int] = None
+
+    def to(self, state: SlotHealth, reason: str = "", frame: int = -1) -> None:
+        if state is self.state:
+            return
+        if state not in _LEGAL[self.state]:
+            raise ValueError(
+                f"illegal slot-health transition {self.state.value} -> "
+                f"{state.value} (slot {self.slot})"
+            )
+        self.tracer.instant(
+            "slot_health",
+            slot=self.slot,
+            prev=self.state.value,
+            to=state.value,
+            reason=reason,
+        )
+        self.metrics.count(
+            "slot_health_transitions",
+            labels={"match_slot": self.slot, "to": state.value},
+        )
+        self.state = state
+        if state is SlotHealth.QUARANTINED:
+            self.last_reason = reason or self.last_reason
+            self.last_fault_frame = frame if frame >= 0 else None
+            self.strikes = 0
+
+    def strike(self, frame: int, reason: str = "watchdog_timeout") -> bool:
+        """Record one watchdog deadline miss; True when the streak crosses
+        ``strike_limit`` (the caller must then quarantine the slot)."""
+        self.strikes += 1
+        self.metrics.count(
+            "watchdog_strikes", labels={"match_slot": self.slot}
+        )
+        if self.state is SlotHealth.HEALTHY:
+            self.to(SlotHealth.DEGRADED, reason=reason, frame=frame)
+        return self.strikes >= self.strike_limit
+
+    def clear(self) -> None:
+        self.strikes = 0
+        if self.state is SlotHealth.DEGRADED:
+            self.to(SlotHealth.HEALTHY)
+
+
+@dataclasses.dataclass
+class SlotTicket:
+    """One match's portable state: everything a slot row or a singleton
+    runner needs to continue the trajectory bitwise. ``state``/``ring`` are
+    device trees (single-slot views — jnp indexing snapshots them, so they
+    stay valid across later dispatches); ``input_log`` is the as-used
+    host log tail the speculation builders seed from."""
+
+    frame: int
+    state: Any  # WorldState, device
+    ring: Any  # SnapshotRing, device, depth = max_prediction + 1
+    input_log: Dict[int, np.ndarray]
+    spec_on: bool = True
+
+
+def adopt_ticket(runner, ticket: SlotTicket) -> None:
+    """Seed a singleton runner from a ticket by DIRECT assignment — not
+    ``restore_state``, which re-seeds the ring empty: a synctest session
+    issues ``LoadGameState(frame - check_distance)`` on its very next
+    advance, so the pre-fault ring entries must survive the move."""
+    runner.state = ticket.state
+    runner.ring = ticket.ring
+    runner.frame = int(ticket.frame)
+    runner._input_log = dict(ticket.input_log)
+
+
+class _SlotRunnerFacade:
+    """The runner-shaped view a :class:`SessionSupervisor` holds while its
+    match lives in a batch slot. Donor-side serving (``_build_payload``
+    reads ``state``/``ring``/``frame``/``max_prediction``; ``dumps_runner``
+    additionally reads the rollback counters) works against the live slot
+    rows; the mutating entry points raise :class:`SlotFault` — recovery
+    must never write through the facade, it must drain the slot to a lane
+    first (the server does this the moment ``should_advance()`` goes
+    False)."""
+
+    def __init__(self, core, slot: int):
+        self._core = core
+        self._slot = int(slot)
+
+    @property
+    def state(self):
+        return self._core.slot_state(self._slot)
+
+    @property
+    def ring(self):
+        return self._core.slot_ring(self._slot)
+
+    @property
+    def frame(self) -> int:
+        return self._core.slots[self._slot].frame
+
+    @property
+    def max_prediction(self) -> int:
+        return self._core.max_prediction
+
+    # dumps_runner metadata: per-slot rollback counts are aggregated on the
+    # core; a rejoiner only needs plausible counters, not exact ones.
+    rollbacks_total = 0
+    rollback_frames_total = 0
+
+    def restore_state(self, frame, state) -> None:
+        raise SlotFault(self._slot, "restore_request", self.frame)
+
+    def handle_requests(self, requests, session=None) -> None:
+        raise SlotFault(self._slot, "unsupported_request", self.frame)
+
+
+class RecoveryLane:
+    """A drained match advancing on a singleton runner until readmission.
+
+    Drive contract mirrors the supervisor drive loop
+    (tests/test_supervisor.py): each :meth:`step` polls, ticks the
+    supervisor (when present), and — if the session is RUNNING and the
+    supervisor allows — advances with up to ``1 + min(frames_behind, 4)``
+    catch-up iterations, treating :class:`PredictionThreshold` as
+    backpressure. The first step applies the ``pending`` request list the
+    faulting tick dropped, so the session and runner frame counters
+    re-converge before any new frame is produced.
+
+    ``ready`` gates readmission on: no pending requests, a clean streak of
+    ``clean_target`` fault-free steps, supervisor HEALTHY with no active
+    rejoin-freeze window, and zero frames behind the remote frontier — the
+    conditions under which the batched core's canonical-burst contract
+    holds again.
+    """
+
+    def __init__(
+        self,
+        handle,
+        session,
+        runner,
+        supervisor=None,
+        local_inputs: Optional[Callable[[int, int], object]] = None,
+        pending: Optional[Tuple[List[object], object]] = None,
+        fault_frame: Optional[int] = 0,
+        clean_target: int = 2,
+        catchup_cap: int = 4,
+    ):
+        self.handle = handle
+        self.session = session
+        self.runner = runner
+        self.supervisor = supervisor
+        self.local_inputs = local_inputs
+        self.pending = pending
+        # None = crash-restart rejoin (no in-process fault frame to
+        # measure recovery depth against).
+        self.fault_frame = None if fault_frame is None else int(fault_frame)
+        self.clean_target = int(clean_target)
+        self.catchup_cap = int(catchup_cap)
+        self.frames_stepped = 0
+        self.errors = 0
+        self.last_error: Optional[BaseException] = None
+        self._clean = 0
+
+    @property
+    def advancing(self) -> bool:
+        return self._clean > 0
+
+    @property
+    def ready(self) -> bool:
+        if self.pending is not None or self._clean < self.clean_target:
+            return False
+        sup = self.supervisor
+        if sup is not None:
+            if sup.health is not Health.HEALTHY:
+                return False
+            if sup._freeze_until is not None:
+                # Post-rejoin frozen-input window: the lane keeps routing
+                # local inputs through sup.input_for until it expires; the
+                # batched fast path does too, but holding the match here
+                # until the window closes keeps readmission unconditional.
+                return False
+            if sup.frames_behind() > 0:
+                return False
+        return True
+
+    def step(self, now: Optional[float] = None) -> None:
+        """One recovery-lane drive iteration; never raises (errors are
+        counted for the server's eviction policy)."""
+        self.frames_stepped += 1
+        try:
+            self._step(now)
+        except PredictionThreshold:
+            self._clean = 0  # backpressure, not a fault — but not clean
+        except Exception as e:  # the lane IS the containment boundary
+            self._clean = 0
+            self.errors += 1
+            self.last_error = e
+
+    def _step(self, now: Optional[float]) -> None:
+        if self.pending is not None:
+            requests, psession = self.pending
+            self.pending = None
+            # The singleton runner handles arbitrary request shapes —
+            # RestoreGameState, non-canonical bursts — which is exactly
+            # why the faulted list is replayed here and not in the batch.
+            self.runner.handle_requests(requests, psession)
+        session = self.session
+        poll = getattr(session, "poll_remote_clients", None)
+        if poll is not None:
+            poll()
+        sup = self.supervisor
+        behind = 0
+        if sup is not None:
+            sup.tick(now)
+            if (
+                session.current_state() != SessionState.RUNNING
+                or not sup.should_advance()
+            ):
+                self._clean = 0
+                return
+            behind = sup.frames_behind()
+        for _ in range(1 + min(behind, self.catchup_cap)):
+            frame = getattr(session, "current_frame", self.runner.frame)
+            if self.local_inputs is not None:
+                for h in session.local_player_handles():
+                    bits = self.local_inputs(frame, h)
+                    if sup is not None:
+                        bits = sup.input_for(h, bits)
+                    session.add_local_input(h, bits)
+            requests = session.advance_frame()
+            self.runner.handle_requests(requests, session)
+        self._clean += 1
+
+    def ticket(self, spec_on: bool = True) -> SlotTicket:
+        r = self.runner
+        return SlotTicket(
+            frame=int(r.frame),
+            state=r.state,
+            ring=r.ring,
+            input_log=dict(r._input_log or {}),
+            spec_on=bool(spec_on),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Server crash-restart checkpoints
+# ---------------------------------------------------------------------------
+
+_HEADER_KEY = "__ggrs_server_header__"
+_CKPT_VERSION = 1
+
+
+class ServerCheckpointer:
+    """Rolling on-disk checkpoints of a whole MatchServer.
+
+    One ``.npz`` per save, written atomically, holding for every live match
+    (batched slots AND recovery lanes): the world state and each snapshot
+    ring row as :class:`~bevy_ggrs_tpu.relay.delta.StateCodec` flat bytes
+    (the relay tier's deterministic layout — byte-identical encode/decode,
+    guarded by a :func:`~bevy_ggrs_tpu.relay.delta.payload_digest` per
+    slot), the ring frame/checksum arrays, the as-used input-log tail, and
+    (synctest) the session's ``state_dict``.
+
+    Restore contract (:meth:`restore`): the caller rebuilds a MatchServer
+    with identical construction parameters plus one attachment per saved
+    match — ``{(group, slot): {"session": ..., "local_inputs": ...,
+    "donor": ...}}``. Synctest matches are re-seeded bitwise at their exact
+    (group, slot) via the traced-index admit path; P2P matches (no
+    serializable session) re-enter as RECOVERING lanes that adopt a full
+    checkpoint from ``donor`` through the supervisor's crash-restart
+    rejoin, then readmit. Cadence tradeoff: a shorter ``interval`` bounds
+    synctest recovery staleness (a restart replays nothing — it resumes AT
+    the checkpoint, so staleness = frames since the last save) at the cost
+    of one full host sync of every slot per save (docs/serving.md).
+    """
+
+    _NAME = re.compile(r"^server_ckpt_(\d+)\.npz$")
+
+    def __init__(self, directory: str, interval: int = 120, keep: int = 3):
+        if interval <= 0 or keep <= 0:
+            raise ValueError("interval and keep must be positive")
+        self.directory = directory
+        self.interval = int(interval)
+        self.keep = int(keep)
+        os.makedirs(directory, exist_ok=True)
+        self.saves_total = 0
+        self.last_save_path: Optional[str] = None
+
+    # -- saving ----------------------------------------------------------
+
+    def _checkpoints(self) -> List[Tuple[int, str]]:
+        out = []
+        for name in os.listdir(self.directory):
+            m = self._NAME.match(name)
+            if m:
+                out.append(
+                    (int(m.group(1)), os.path.join(self.directory, name))
+                )
+        return sorted(out)
+
+    def latest(self) -> Optional[str]:
+        ckpts = self._checkpoints()
+        return ckpts[-1][1] if ckpts else None
+
+    def maybe_save(self, server) -> Optional[str]:
+        """Checkpoint iff ``frames_served`` is an ``interval`` boundary."""
+        n = server.frames_served
+        if n == 0 or n % self.interval:
+            return None
+        return self.save(server)
+
+    def save(self, server) -> str:
+        from bevy_ggrs_tpu.relay.delta import payload_digest
+        from bevy_ggrs_tpu.state import to_host
+
+        codec = server.state_codec()
+        arrays: Dict[str, np.ndarray] = {}
+        matches: List[Dict] = []
+        for j, snap in enumerate(server.snapshot_matches()):
+            state_bytes = codec.encode(to_host(snap["state"]))
+            ring = snap["ring"]
+            depth = int(ring.frames.shape[0])
+            ring_rows = np.stack(
+                [
+                    np.frombuffer(
+                        codec.encode(
+                            to_host(_ring_row(ring.states, d))
+                        ),
+                        dtype=np.uint8,
+                    )
+                    for d in range(depth)
+                ]
+            )
+            log = snap["input_log"]
+            # Tail only: frames the speculation builders / forced-rollback
+            # window can still reach (the rest is GC fodder anyway).
+            tail_from = snap["frame"] - depth - 8
+            frames = sorted(f for f in log if f >= tail_from)
+            log_frames = np.asarray(frames, dtype=np.int64)
+            log_bits = (
+                np.stack([np.asarray(log[f]) for f in frames])
+                if frames
+                else np.zeros((0,), dtype=np.uint8)
+            )
+            arrays[f"m{j}_state"] = np.frombuffer(state_bytes, dtype=np.uint8)
+            arrays[f"m{j}_ring"] = ring_rows
+            arrays[f"m{j}_ring_frames"] = np.asarray(
+                ring.frames, dtype=np.int32
+            )
+            arrays[f"m{j}_ring_cs"] = np.asarray(
+                ring.checksums, dtype=np.uint32
+            )
+            arrays[f"m{j}_log_frames"] = log_frames
+            arrays[f"m{j}_log_bits"] = log_bits
+            matches.append(
+                {
+                    "j": j,
+                    "group": snap["handle"].group,
+                    "slot": snap["handle"].slot,
+                    "frame": int(snap["frame"]),
+                    "spec_on": bool(snap["spec_on"]),
+                    "kind": snap["kind"],
+                    "digest": payload_digest(state_bytes),
+                    "session_state": snap["session_state"],
+                }
+            )
+        header = json.dumps(
+            {
+                "version": _CKPT_VERSION,
+                "frames_served": int(server.frames_served),
+                "codec_size": int(codec.size),
+                "matches": matches,
+            }
+        )
+        arrays[_HEADER_KEY] = np.frombuffer(header.encode(), dtype=np.uint8)
+        import io
+
+        buf = io.BytesIO()
+        np.savez_compressed(buf, **arrays)
+        path = os.path.join(
+            self.directory, f"server_ckpt_{server.frames_served}.npz"
+        )
+        fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(buf.getvalue())
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        for _, stale in self._checkpoints()[: -self.keep]:
+            os.unlink(stale)
+        self.saves_total += 1
+        self.last_save_path = path
+        return path
+
+    # -- restoring -------------------------------------------------------
+
+    def restore(
+        self,
+        server,
+        attachments: Dict[Tuple[int, int], Dict],
+        path: Optional[str] = None,
+    ) -> List:
+        """Re-seed a freshly built server from the newest (or named)
+        checkpoint. Returns the re-established MatchHandles. Raises
+        ``ValueError`` on digest/template mismatch — a corrupted checkpoint
+        must never silently produce a plausible fleet."""
+        import jax
+        import jax.numpy as jnp
+
+        from bevy_ggrs_tpu.relay.delta import payload_digest
+        from bevy_ggrs_tpu.state import SnapshotRing, WorldState
+
+        path = path if path is not None else self.latest()
+        if path is None:
+            raise ValueError(f"no server checkpoint in {self.directory!r}")
+        codec = server.state_codec()
+        with np.load(path) as npz:
+            header = json.loads(bytes(npz[_HEADER_KEY]).decode())
+            if header.get("version") != _CKPT_VERSION:
+                raise ValueError(
+                    f"server checkpoint {path!r}: version "
+                    f"{header.get('version')} != {_CKPT_VERSION}"
+                )
+            if header["codec_size"] != codec.size:
+                raise ValueError(
+                    f"server checkpoint {path!r}: state layout is "
+                    f"{header['codec_size']} bytes, server template needs "
+                    f"{codec.size} — mismatched world registry/capacity"
+                )
+            handles = []
+            for e in header["matches"]:
+                key = (int(e["group"]), int(e["slot"]))
+                att = attachments.get(key)
+                if att is None:
+                    raise ValueError(
+                        f"server checkpoint {path!r}: no attachment for "
+                        f"match at group={key[0]} slot={key[1]}"
+                    )
+                j = e["j"]
+                state_bytes = npz[f"m{j}_state"].tobytes()
+                if payload_digest(state_bytes) != e["digest"]:
+                    raise ValueError(
+                        f"server checkpoint {path!r}: slot {key} state "
+                        "fails its integrity digest"
+                    )
+                if e["kind"] != "synctest":
+                    # P2P: the session is live network state we never
+                    # serialized — rejoin from a surviving donor instead.
+                    handles.append(
+                        server.adopt_rejoin(
+                            key,
+                            att["session"],
+                            att.get("local_inputs"),
+                            att["donor"],
+                        )
+                    )
+                    continue
+                state = WorldState(**codec.decode(state_bytes))
+                ring_rows = npz[f"m{j}_ring"]
+                depth = ring_rows.shape[0]
+                row_states = [
+                    WorldState(**codec.decode(ring_rows[d].tobytes()))
+                    for d in range(depth)
+                ]
+                ring = SnapshotRing(
+                    states=jax.tree_util.tree_map(
+                        lambda *xs: jnp.stack(
+                            [jnp.asarray(x) for x in xs]
+                        ),
+                        *row_states,
+                    ),
+                    frames=jnp.asarray(
+                        npz[f"m{j}_ring_frames"], dtype=jnp.int32
+                    ),
+                    checksums=jnp.asarray(
+                        npz[f"m{j}_ring_cs"], dtype=jnp.uint32
+                    ),
+                )
+                log_frames = npz[f"m{j}_log_frames"]
+                log_bits = npz[f"m{j}_log_bits"]
+                input_log = {
+                    int(f): np.asarray(log_bits[k])
+                    for k, f in enumerate(log_frames)
+                }
+                ticket = SlotTicket(
+                    frame=int(e["frame"]),
+                    state=jax.tree_util.tree_map(jnp.asarray, state),
+                    ring=ring,
+                    input_log=input_log,
+                    spec_on=bool(e["spec_on"]),
+                )
+                session = att["session"]
+                if e["session_state"] is not None:
+                    session.load_state_dict(e["session_state"])
+                handles.append(
+                    server.resume_match(
+                        session,
+                        att.get("local_inputs"),
+                        ticket,
+                        handle=key,
+                    )
+                )
+        return handles
+
+
+def _ring_row(states, d: int):
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: x[d], states)

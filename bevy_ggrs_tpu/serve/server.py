@@ -22,21 +22,52 @@ spectator sessions all fit):
   fully confirmed every frame (synctest);
 - ``poll_remote_clients()`` (optional) — pumped before input collection;
 - ``report_checksum(frame, checksum)`` / ``wants_checksum(frame)``
-  (optional) — fed from the core's deferred checksum reports.
+  (optional) — fed from the core's deferred checksum reports;
+- ``checksum_votes`` + ``drain_control`` (optional) — their presence
+  marks a supervisable P2P session: the server wraps it in a
+  :class:`~bevy_ggrs_tpu.session.supervisor.SessionSupervisor` whose
+  runner is a facade over the live batch slot, so desync ballots and
+  donor-side state serving work while the match is batched.
+
+Fault domains (docs/serving.md "Failure domains"): each match carries a
+:class:`~bevy_ggrs_tpu.serve.faults.SlotHealthFSM`. A session that raises,
+blows its per-tick watchdog budget ``strike_limit`` times, or trips the
+batched core's canonical-burst contract is fenced at the group boundary —
+its slot drains to a singleton :class:`~bevy_ggrs_tpu.serve.faults.
+RecoveryLane` (all lanes share ONE warmed rollout executable, so the
+compile-counter delta through any amount of fault churn stays 0), the
+other S−1 lanes dispatch on time, and the match readmits at its reserved
+slot index once the lane reports clean — bitwise-continuous with its
+pre-fault trajectory. A ``checkpoint_dir`` arms periodic whole-server
+checkpoints (:class:`~bevy_ggrs_tpu.serve.faults.ServerCheckpointer`) for
+kill -9 crash-restart.
 
 Observability: every group dispatch runs under a ``serve_tick`` span and
 per-slot counters carry a ``match_slot`` label; ``slots_active``,
-``slots_free`` and ``last_stagger_jitter_ms`` are live gauges the
-FlightRecorder's ``capture(server=...)`` columns snapshot.
+``slots_free``, ``slots_quarantined``, ``slots_recovering`` and
+``last_stagger_jitter_ms`` are live gauges the FlightRecorder's
+``capture(server=...)`` columns snapshot, and every fault/readmit emits
+``slot_fault``/``slot_recover`` tracer instants.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from bevy_ggrs_tpu.serve.batch import BatchedSessionCore, BatchedTickExecutor
+from bevy_ggrs_tpu.serve.faults import (
+    RecoveryLane,
+    ServerCheckpointer,
+    SlotFault,
+    SlotHealth,
+    SlotHealthFSM,
+    SlotTicket,
+    _SlotRunnerFacade,
+    adopt_ticket,
+)
+from bevy_ggrs_tpu.session.common import PredictionThreshold, SessionState
 
 
 @dataclasses.dataclass(frozen=True)
@@ -46,11 +77,22 @@ class MatchHandle:
 
 
 class _Match:
-    __slots__ = ("session", "local_inputs")
+    __slots__ = ("session", "local_inputs", "fsm", "supervisor", "spec_on")
 
-    def __init__(self, session, local_inputs):
+    def __init__(self, session, local_inputs, fsm, supervisor, spec_on):
         self.session = session
         self.local_inputs = local_inputs
+        self.fsm = fsm
+        self.supervisor = supervisor
+        self.spec_on = spec_on
+
+
+def _supervisable(session) -> bool:
+    """P2P-shaped sessions (desync ballots + control channel) get a
+    SessionSupervisor; synctest/spectator sessions do not."""
+    return hasattr(session, "checksum_votes") and hasattr(
+        session, "drain_control"
+    )
 
 
 class MatchServer:
@@ -71,6 +113,13 @@ class MatchServer:
         tracer=None,
         clock=time.perf_counter,
         report_checksums: bool = True,
+        watchdog_budget_ms: Optional[float] = None,
+        watchdog_strike_limit: int = 3,
+        recovery_deadline_frames: int = 900,
+        lane_error_limit: int = 8,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_interval: int = 120,
+        checkpoint_keep: int = 3,
     ):
         from bevy_ggrs_tpu.obs.trace import null_tracer
         from bevy_ggrs_tpu.utils.metrics import null_metrics
@@ -85,6 +134,18 @@ class MatchServer:
         self.tracer = tracer if tracer is not None else null_tracer
         self.frame_ms = float(frame_ms)
         self._clock = clock
+        # Watchdog: a session's host work (poll + inputs + advance) gets
+        # two frame budgets before a miss counts as a strike — generous
+        # enough for GC hiccups, tight enough that a hung session is
+        # fenced within strike_limit frames.
+        self.watchdog_budget_ms = (
+            2.0 * self.frame_ms
+            if watchdog_budget_ms is None
+            else float(watchdog_budget_ms)
+        )
+        self.watchdog_strike_limit = int(watchdog_strike_limit)
+        self.recovery_deadline_frames = int(recovery_deadline_frames)
+        self.lane_error_limit = int(lane_error_limit)
         G = max(1, int(stagger_groups))
         per_group = -(-int(capacity) // G)  # ceil: capacity is a floor
         self.capacity = per_group * G
@@ -102,29 +163,147 @@ class MatchServer:
             )
             for _ in range(G)
         ]
+        # Lane-runner construction parameters (recovery lanes are built
+        # on demand; they all share one warmed rollout executable so the
+        # drain -> recover -> readmit cycle never compiles).
+        from bevy_ggrs_tpu.rollout import RolloutExecutor
+
+        self._schedule = schedule
+        self._max_prediction = int(max_prediction)
+        self._num_players = int(num_players)
+        self._input_spec = input_spec
+        self._report_checksums = bool(report_checksums)
+        self._template = self.groups[0]._template
+        self._recovery_exec = RolloutExecutor(
+            schedule, self._max_prediction + 2, state_template=self._template
+        )
+        self._codec = None
         self._matches: Dict[MatchHandle, _Match] = {}
+        self._lanes: Dict[MatchHandle, RecoveryLane] = {}
+        self._reserved: Dict[int, set] = {g: set() for g in range(G)}
+        self.checkpointer = (
+            ServerCheckpointer(
+                checkpoint_dir, checkpoint_interval, checkpoint_keep
+            )
+            if checkpoint_dir is not None
+            else None
+        )
         self.frames_served = 0
+        self.faults_total = 0
+        self.readmissions_total = 0
+        self.evictions_total = 0
+        self.last_recovery_frames: Optional[int] = None
         self.last_stagger_jitter_ms: Optional[float] = None
 
     # -- gauges ---------------------------------------------------------
 
     @property
     def slots_active(self) -> int:
-        return sum(g.active_count for g in self.groups)
+        """Matches currently served: batched slots + recovery lanes."""
+        return sum(g.active_count for g in self.groups) + len(self._lanes)
 
     @property
     def slots_free(self) -> int:
-        return self.capacity - self.slots_active
+        reserved = sum(len(r) for r in self._reserved.values())
+        return (
+            self.capacity
+            - sum(g.active_count for g in self.groups)
+            - reserved
+        )
+
+    @property
+    def slots_quarantined(self) -> int:
+        return sum(
+            1
+            for m in self._matches.values()
+            if m.fsm.state is SlotHealth.QUARANTINED
+        )
+
+    @property
+    def slots_recovering(self) -> int:
+        return sum(
+            1
+            for m in self._matches.values()
+            if m.fsm.state is SlotHealth.RECOVERING
+        )
 
     def cache_size(self) -> int:
         return self._exec.cache_size()
+
+    def health_of(self, handle: MatchHandle) -> SlotHealth:
+        return self._matches[handle].fsm.state
+
+    def state_codec(self):
+        """The server's StateCodec (relay-tier flat-byte layout), built
+        lazily from the world template — checkpoints and parity checks
+        share one deterministic encoding."""
+        if self._codec is None:
+            from bevy_ggrs_tpu.relay.delta import StateCodec
+            from bevy_ggrs_tpu.state import to_host
+
+            self._codec = StateCodec(to_host(self._template))
+        return self._codec
 
     # -- lifecycle ------------------------------------------------------
 
     def warmup(self) -> None:
         """Compile the shared batched tick + admit programs (one dispatch
-        through group 0 covers every group — they share the executor)."""
+        through group 0 covers every group — they share the executor) AND
+        the shared recovery-lane rollout executable, so the drain ->
+        recover -> readmit cycle is recompile-free from here on."""
         self.groups[0].warmup()
+        self._make_lane_runner().warmup()
+
+    def _make_lane_runner(self):
+        from bevy_ggrs_tpu.runner import RollbackRunner
+
+        runner = RollbackRunner(
+            self._schedule, self._template, self._max_prediction,
+            self._num_players, self._input_spec,
+            report_checksums=self._report_checksums,
+            metrics=self.metrics, tracer=self.tracer,
+        )
+        runner.executor = self._recovery_exec
+        runner._input_log = {}
+        return runner
+
+    def _free_unreserved(self, group: int) -> List[int]:
+        reserved = self._reserved[group]
+        return [
+            i
+            for i in self.groups[group].free_slots()
+            if i not in reserved
+        ]
+
+    def _register(
+        self,
+        handle: MatchHandle,
+        session,
+        local_inputs,
+        spec_on: bool,
+        initial: SlotHealth = SlotHealth.HEALTHY,
+        supervisor=None,
+    ) -> _Match:
+        fsm = SlotHealthFSM(
+            handle.slot,
+            metrics=self.metrics,
+            tracer=self.tracer,
+            strike_limit=self.watchdog_strike_limit,
+            initial=initial,
+        )
+        if supervisor is None and _supervisable(session):
+            from bevy_ggrs_tpu.session.supervisor import SessionSupervisor
+
+            supervisor = SessionSupervisor(
+                session,
+                _SlotRunnerFacade(self.groups[handle.group], handle.slot),
+                metrics=self.metrics,
+                tracer=self.tracer,
+                clock=self._clock,
+            )
+        m = _Match(session, local_inputs, fsm, supervisor, bool(spec_on))
+        self._matches[handle] = m
+        return m
 
     def add_match(
         self,
@@ -135,22 +314,247 @@ class MatchServer:
     ) -> MatchHandle:
         """Admit a match: its session + a ``local_inputs(frame, handle) ->
         bits`` callback feeding the session's local handles each frame.
-        Slots balance across stagger groups (least-loaded first)."""
-        group = min(
+        Slots balance across stagger groups (least-loaded first); slots
+        reserved for recovering matches are never handed out."""
+        group = max(
             range(len(self.groups)),
-            key=lambda g: (self.groups[g].active_count, g),
+            key=lambda g: (len(self._free_unreserved(g)), -g),
         )
-        core = self.groups[group]
-        if not core.free_slots():
+        free = self._free_unreserved(group)
+        if not free:
             raise RuntimeError("server at capacity")
-        slot = core.admit(initial_state=initial_state, spec_on=spec_on)
+        core = self.groups[group]
+        slot = core.admit(
+            initial_state=initial_state, slot=free[0], spec_on=spec_on
+        )
         handle = MatchHandle(group, slot)
-        self._matches[handle] = _Match(session, local_inputs)
+        self._register(handle, session, local_inputs, spec_on)
         return handle
 
     def retire_match(self, handle: MatchHandle) -> None:
-        self.groups[handle.group].retire(handle.slot)
+        lane = self._lanes.pop(handle, None)
+        if lane is not None:
+            self._reserved[handle.group].discard(handle.slot)
+        else:
+            self.groups[handle.group].retire(handle.slot)
         self._matches.pop(handle, None)
+
+    def suspend_match(self, handle: MatchHandle) -> SlotTicket:
+        """Voluntary drain: extract the match's full trajectory state as a
+        :class:`SlotTicket` and free its slot. The SAME match (same
+        session, same frame counters) can later :meth:`resume_match` —
+        possibly into a different slot or a different server — and
+        continue bitwise. Not valid while the match is on a recovery
+        lane."""
+        if handle in self._lanes:
+            raise RuntimeError(
+                f"match {handle} is on a recovery lane; wait for "
+                "readmission or retire it"
+            )
+        ticket = self.groups[handle.group].extract(handle.slot)
+        self._matches.pop(handle, None)
+        return ticket
+
+    def resume_match(
+        self,
+        session,
+        local_inputs: Optional[Callable[[int, int], object]] = None,
+        ticket: Optional[SlotTicket] = None,
+        handle=None,
+    ) -> MatchHandle:
+        """Readmit a suspended (or checkpoint-restored) match from its
+        ticket, mid-trajectory. ``handle`` pins the exact (group, slot) —
+        crash-restart re-seeds every match where it lived, keeping
+        user-held handles valid."""
+        if ticket is None:
+            raise ValueError("resume_match requires a ticket")
+        if handle is not None:
+            handle = MatchHandle(*handle) if isinstance(handle, tuple) else handle
+            if handle.slot in self._reserved[handle.group]:
+                raise RuntimeError(f"slot {handle} is reserved")
+            group, slot = handle.group, handle.slot
+        else:
+            group = max(
+                range(len(self.groups)),
+                key=lambda g: (len(self._free_unreserved(g)), -g),
+            )
+            free = self._free_unreserved(group)
+            if not free:
+                raise RuntimeError("server at capacity")
+            slot = free[0]
+        core = self.groups[group]
+        slot = core.admit(slot=slot, ticket=ticket)
+        handle = MatchHandle(group, slot)
+        self._register(handle, session, local_inputs, ticket.spec_on)
+        return handle
+
+    def adopt_rejoin(
+        self,
+        handle,
+        session,
+        local_inputs: Optional[Callable[[int, int], object]] = None,
+        donor=None,
+    ) -> MatchHandle:
+        """Crash-restart path for a P2P match: reserve its slot and start
+        a RECOVERING lane whose supervisor adopts a full checkpoint from
+        ``donor`` (the surviving peer) via :meth:`~bevy_ggrs_tpu.session.
+        supervisor.SessionSupervisor.begin_rejoin`. The match readmits at
+        the reserved slot once caught up and out of its frozen-input
+        window."""
+        from bevy_ggrs_tpu.session.supervisor import SessionSupervisor
+
+        handle = MatchHandle(*handle) if isinstance(handle, tuple) else handle
+        if self.groups[handle.group].slots[handle.slot].active:
+            raise RuntimeError(f"slot {handle} is occupied")
+        runner = self._make_lane_runner()
+        supervisor = SessionSupervisor(
+            session, runner, metrics=self.metrics, tracer=self.tracer,
+            clock=self._clock,
+        )
+        if donor is not None:
+            supervisor.begin_rejoin(donor)
+        m = self._register(
+            handle, session, local_inputs, True,
+            initial=SlotHealth.RECOVERING, supervisor=supervisor,
+        )
+        self._reserved[handle.group].add(handle.slot)
+        self._lanes[handle] = RecoveryLane(
+            handle, session, runner, supervisor=supervisor,
+            local_inputs=local_inputs, fault_frame=None,
+        )
+        return handle
+
+    # -- fault containment ----------------------------------------------
+
+    def _fault(
+        self,
+        handle: MatchHandle,
+        m: _Match,
+        reason: str,
+        cause: Optional[BaseException] = None,
+        pending: Optional[Tuple[List[object], object]] = None,
+    ) -> None:
+        """Fence a sick match off the batch: quarantine its FSM, extract
+        its slot into a ticket (reserving the slot index for readmission),
+        and stand up a recovery lane seeded from it. The ``pending``
+        request list the faulting tick dropped replays on the lane's
+        singleton runner first — the escape hatch for request shapes the
+        batch can't express (RestoreGameState, non-canonical bursts)."""
+        core = self.groups[handle.group]
+        frame = core.slots[handle.slot].frame
+        m.fsm.to(SlotHealth.QUARANTINED, reason=reason, frame=frame)
+        self.faults_total += 1
+        self.metrics.count("slot_faults")
+        self.metrics.count(
+            "slot_faults",
+            labels={"match_slot": handle.slot, "reason": reason},
+        )
+        self.tracer.instant(
+            "slot_fault",
+            group=handle.group,
+            slot=handle.slot,
+            reason=reason,
+            frame=frame,
+            cause=repr(cause) if cause is not None else "",
+        )
+        ticket = core.extract(handle.slot)
+        self._reserved[handle.group].add(handle.slot)
+        runner = self._make_lane_runner()
+        adopt_ticket(runner, ticket)
+        if m.supervisor is not None:
+            m.supervisor.retarget(runner)
+        self._lanes[handle] = RecoveryLane(
+            handle, m.session, runner, supervisor=m.supervisor,
+            local_inputs=m.local_inputs, pending=pending, fault_frame=frame,
+        )
+
+    def _readmit(self, handle: MatchHandle, lane: RecoveryLane) -> None:
+        m = self._matches[handle]
+        core = self.groups[handle.group]
+        ticket = lane.ticket(spec_on=m.spec_on)
+        core.admit(slot=handle.slot, ticket=ticket)
+        self._reserved[handle.group].discard(handle.slot)
+        del self._lanes[handle]
+        if m.supervisor is not None:
+            m.supervisor.retarget(_SlotRunnerFacade(core, handle.slot))
+        m.fsm.to(SlotHealth.HEALTHY)
+        self.readmissions_total += 1
+        self.metrics.count("slot_readmissions")
+        recovery = (
+            None
+            if lane.fault_frame is None
+            else ticket.frame - lane.fault_frame
+        )
+        if recovery is not None:
+            self.last_recovery_frames = recovery
+            self.metrics.observe("slot_recovery_frames", recovery)
+        self.tracer.instant(
+            "slot_recover",
+            group=handle.group,
+            slot=handle.slot,
+            frame=ticket.frame,
+            recovery_frames=-1 if recovery is None else recovery,
+        )
+
+    def _evict(self, handle: MatchHandle, lane: RecoveryLane) -> None:
+        m = self._matches[handle]
+        m.fsm.to(SlotHealth.EVICTED, reason="recovery_deadline")
+        del self._lanes[handle]
+        self._reserved[handle.group].discard(handle.slot)
+        self._matches.pop(handle, None)
+        self.evictions_total += 1
+        self.metrics.count("slot_evictions")
+        self.metrics.count(
+            "slot_evictions", labels={"match_slot": handle.slot}
+        )
+        self.tracer.instant(
+            "slot_evict",
+            group=handle.group,
+            slot=handle.slot,
+            errors=lane.errors,
+            last_error=repr(lane.last_error),
+        )
+
+    # -- crash-restart checkpoints --------------------------------------
+
+    def snapshot_matches(self) -> List[Dict]:
+        """Uniform per-match state records for the checkpointer: batched
+        slots read their device rows, recovering matches read their lane
+        runner — both carry frame, world state, full ring, and the as-used
+        input-log tail."""
+        out: List[Dict] = []
+        for handle, m in self._matches.items():
+            lane = self._lanes.get(handle)
+            if lane is not None:
+                r = lane.runner
+                state, ring, frame = r.state, r.ring, int(r.frame)
+                log = dict(r._input_log or {})
+            else:
+                core = self.groups[handle.group]
+                s = core.slots[handle.slot]
+                state = core.slot_state(handle.slot)
+                ring = core.slot_ring(handle.slot)
+                frame, log = int(s.frame), dict(s.input_log)
+            session_state = None
+            kind = "p2p"
+            if m.supervisor is None:
+                sd = getattr(m.session, "state_dict", None)
+                if sd is not None:
+                    session_state = sd()
+                    kind = "synctest"
+            out.append(
+                {
+                    "handle": handle,
+                    "kind": kind,
+                    "frame": frame,
+                    "state": state,
+                    "ring": ring,
+                    "input_log": log,
+                    "spec_on": m.spec_on,
+                    "session_state": session_state,
+                }
+            )
+        return out
 
     # -- the frame loop -------------------------------------------------
 
@@ -160,16 +564,30 @@ class MatchServer:
         at its offset within the frame. The loop itself never sleeps (the
         caller owns pacing, as everywhere in this codebase); the jitter
         gauge records how far each group's dispatch drifted from its ideal
-        offset given the work that preceded it."""
+        offset given the work that preceded it.
+
+        Fault containment: any match whose host work raises or blows the
+        watchdog budget is fenced BEFORE the group dispatch; a
+        :class:`SlotFault` from the dispatch itself (pre-mutation, so
+        sibling slots are untouched) drops that slot and re-ticks the
+        rest. Recovery lanes step after the groups, readmitting or
+        evicting as they resolve."""
         t0 = self._clock()
         worst_jitter = 0.0
-        by_group: Dict[int, Dict[int, tuple]] = {}
+        by_group: Dict[int, Dict[int, Tuple[MatchHandle, _Match]]] = {}
         for handle, m in self._matches.items():
-            by_group.setdefault(handle.group, {})[handle.slot] = m
+            if handle in self._lanes:
+                continue  # draining/recovering: not on the batch path
+            by_group.setdefault(handle.group, {})[handle.slot] = (handle, m)
         for g, core in enumerate(self.groups):
             matches = by_group.get(g)
             if not matches:
                 continue
+            # Deliver last tick's deferred checksum reports BEFORE any
+            # session polls: a rollback's corrected re-report must land
+            # before the session can send that frame's checksum to peers,
+            # or a settled-but-stale value leaks out as a false desync.
+            core.flush_reports()
             ideal_off = g * self.frame_ms / len(self.groups)
             actual_off = (self._clock() - t0) * 1000.0
             jitter = actual_off - ideal_off
@@ -179,22 +597,93 @@ class MatchServer:
                 "serve_tick", group=g, matches=len(matches)
             ), self.metrics.timer("serve_tick"):
                 work = {}
-                for slot, m in matches.items():
+                for slot, (handle, m) in matches.items():
                     session = m.session
-                    poll = getattr(session, "poll_remote_clients", None)
-                    if poll is not None:
-                        poll()
-                    frame = core.slots[slot].frame
-                    if m.local_inputs is not None:
-                        for h in session.local_player_handles():
-                            session.add_local_input(
-                                h, m.local_inputs(frame, h)
+                    t_m = self._clock()
+                    try:
+                        sup = m.supervisor
+                        if sup is not None:
+                            sup.tick(t_m)
+                            if not sup.should_advance():
+                                # Lost a desync ballot (or mid-rejoin):
+                                # the state transfer needs a real runner.
+                                self._fault(
+                                    handle, m, "supervisor_quarantine"
+                                )
+                                continue
+                        poll = getattr(session, "poll_remote_clients", None)
+                        if poll is not None:
+                            poll()
+                        cur = getattr(session, "current_state", None)
+                        if (
+                            cur is not None
+                            and cur() != SessionState.RUNNING
+                        ):
+                            continue  # still synchronizing: no work yet
+                        frame = core.slots[slot].frame
+                        if m.local_inputs is not None:
+                            for h in session.local_player_handles():
+                                bits = m.local_inputs(frame, h)
+                                if sup is not None:
+                                    bits = sup.input_for(h, bits)
+                                session.add_local_input(h, bits)
+                        requests = session.advance_frame()
+                        conf = getattr(session, "confirmed_frame", None)
+                        confirmed = conf() if conf is not None else None
+                    except PredictionThreshold:
+                        continue  # backpressure, not a fault: no-op frame
+                    except SlotFault as f:
+                        self._fault(handle, m, f.reason, cause=f)
+                        continue
+                    except Exception as e:
+                        self._fault(handle, m, "session_error", cause=e)
+                        continue
+                    elapsed_ms = (self._clock() - t_m) * 1000.0
+                    if elapsed_ms > self.watchdog_budget_ms:
+                        if m.fsm.strike(frame):
+                            # Deadline expiry: the requests are already in
+                            # hand — they ride to the lane so session and
+                            # runner frame counters stay converged.
+                            self._fault(
+                                handle, m, "watchdog_timeout",
+                                pending=(requests, session),
                             )
-                    requests = session.advance_frame()
-                    conf = getattr(session, "confirmed_frame", None)
-                    confirmed = conf() if conf is not None else None
+                            continue
+                    else:
+                        m.fsm.clear()
                     work[slot] = (requests, confirmed, session)
-                core.tick(work)
+                while work:
+                    try:
+                        core.tick(work)
+                        break
+                    except SlotFault as f:
+                        requests, _conf, session = work.pop(f.slot)
+                        handle = MatchHandle(g, f.slot)
+                        self._fault(
+                            handle, self._matches[handle], f.reason,
+                            cause=f, pending=(requests, session),
+                        )
+        # Recovery lanes: off the hot path, after every group dispatched.
+        now = self._clock()
+        for handle, lane in list(self._lanes.items()):
+            m = self._matches.get(handle)
+            if m is None:
+                continue
+            with self.tracer.span(
+                "lane_step", group=handle.group, slot=handle.slot
+            ):
+                lane.step(now)
+            if m.fsm.state is SlotHealth.QUARANTINED and lane.advancing:
+                m.fsm.to(SlotHealth.RECOVERING)
+            if lane.ready and m.fsm.state is SlotHealth.RECOVERING:
+                self._readmit(handle, lane)
+            elif (
+                lane.frames_stepped > self.recovery_deadline_frames
+                or lane.errors > self.lane_error_limit
+            ):
+                self._evict(handle, lane)
         self.last_stagger_jitter_ms = worst_jitter
         self.frames_served += 1
         self.metrics.count("frames_served")
+        if self.checkpointer is not None:
+            self.checkpointer.maybe_save(self)

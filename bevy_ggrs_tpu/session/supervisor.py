@@ -163,6 +163,14 @@ class SessionSupervisor:
                 )
         return behind
 
+    def retarget(self, runner) -> None:
+        """Swap the runner this supervisor drives and serves from. The
+        serve tier moves a match between a batch-slot facade and a
+        singleton recovery lane (serve/faults.py) without rebuilding
+        supervisor state — pending votes, in-flight transfers, and the
+        post-rejoin frozen-input window all carry across the swap."""
+        self.runner = runner
+
     def begin_rejoin(self, donor_addr) -> None:
         """Restarted-process entry point: after building a fresh session +
         runner (same topology) call this once; the supervisor waits for the

@@ -336,7 +336,11 @@ def test_match_server_synctest_end_to_end():
 
 
 def test_non_standard_burst_rejected():
+    from bevy_ggrs_tpu.serve.faults import SlotFault
+
     core = make_core(num_slots=2)
     slot = core.admit()
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(SlotFault) as exc:
         core.tick({slot: ([adv([1, 2])], 0, None)})  # advance without save
+    assert exc.value.slot == slot
+    assert exc.value.reason == "non_canonical_burst"

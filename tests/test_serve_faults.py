@@ -1,0 +1,476 @@
+"""Serve-tier fault domains: blast-radius containment contracts.
+
+The acceptance gates for `serve/faults.py` + the MatchServer fault loop:
+
+- Fault atomicity: a :class:`SlotFault` escaping a batched tick leaves
+  EVERY slot — including the faulting one — bitwise untouched, and the
+  round re-ticks cleanly without it.
+- Typed faults: the blanket rejections the batch used to raise
+  (NotImplementedError / ValueError) are now :class:`SlotFault` with a
+  machine-readable reason, so the server can fence exactly one slot.
+- Drain -> recover -> readmit is bitwise-continuous with the uninterrupted
+  trajectory AND recompile-free (the churn contract extends to fault
+  churn: all recovery lanes share one warmed rollout executable).
+- The watchdog fences a deliberately-hung session within
+  ``strike_limit`` frames; siblings keep their cadence.
+- Crash-restart: a checkpointed server rebuilt from disk resumes every
+  synctest match bitwise at its exact (group, slot).
+"""
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu.models import box_game
+from bevy_ggrs_tpu.runner import RollbackRunner
+from bevy_ggrs_tpu.serve import (
+    MatchServer,
+    ServerCheckpointer,
+    SlotFault,
+    SlotHealth,
+    SlotHealthFSM,
+    SlotTicket,
+)
+from bevy_ggrs_tpu.serve.faults import adopt_ticket
+from bevy_ggrs_tpu.session.builder import SessionBuilder
+from bevy_ggrs_tpu.session.requests import RestoreGameState, SaveGameState
+from bevy_ggrs_tpu.state import checksum, combine64
+from bevy_ggrs_tpu.utils import xla_cache
+from bevy_ggrs_tpu.utils.metrics import Metrics
+from tests.test_batched_sessions import (
+    BRANCHES,
+    MAXPRED,
+    P,
+    SPEC_FRAMES,
+    adv,
+    assert_slot_equals_runner,
+    drive,
+    make_core,
+    make_script,
+    make_singleton,
+)
+
+
+def slot_cs(core, slot):
+    return combine64(checksum(core.slot_state(slot)))
+
+
+# ---------------------------------------------------------------------------
+# Core-level: typed faults + atomicity
+# ---------------------------------------------------------------------------
+
+
+def test_slot_fault_reasons_are_typed():
+    core = make_core(num_slots=2)
+    slot = core.admit()
+    with pytest.raises(SlotFault) as ei:
+        core.tick({slot: ([RestoreGameState(0, None)], None, None)})
+    assert (ei.value.slot, ei.value.reason) == (slot, "restore_request")
+    with pytest.raises(SlotFault) as ei:
+        core.tick({slot: ([SaveGameState(0)], None, None)})  # save, no adv
+    assert ei.value.reason == "non_canonical_burst"
+    too_deep = []
+    for f in range(core.burst_frames + 1):
+        too_deep += [SaveGameState(f), adv([1, 2])]
+    with pytest.raises(SlotFault) as ei:
+        core.tick({slot: (too_deep, None, None)})
+    assert ei.value.reason == "burst_overflow"
+    assert ei.value.frame == 0
+
+
+def test_fault_leaves_every_slot_bitwise_untouched():
+    """THE isolation regression: one slot's bad request list in a
+    multi-slot round must not move ANY slot — not the siblings (whose
+    work shared the aborted round) and not the faulter itself — and the
+    round must re-tick cleanly without the faulted slot."""
+    core = make_core(num_slots=3)
+    a, b = core.admit(), core.admit()
+    sa = make_script(seed=11, depth=2, cycles=2)
+    sb = make_script(seed=12, depth=3, cycles=2)
+    half = len(sb) // 2
+    drive(core, {a: sa[: len(sa) // 2], b: sb[:half]})
+    before = {
+        s: (core.slots[s].frame, slot_cs(core, s),
+            np.asarray(core.rings.checksums)[s].copy())
+        for s in (a, b)
+    }
+    with pytest.raises(SlotFault) as ei:
+        core.tick({
+            a: ([adv([1, 2])], None, None),  # advance without save
+            b: (sb[half][0], sb[half][1], None),
+        })
+    assert ei.value.slot == a
+    for s in (a, b):
+        frame, cs, ring_cs = before[s]
+        assert core.slots[s].frame == frame
+        assert slot_cs(core, s) == cs
+        assert np.array_equal(np.asarray(core.rings.checksums)[s], ring_cs)
+    # Drop the faulter, re-tick the survivor's same work, finish both
+    # scripts: bitwise parity with uninterrupted singletons for BOTH.
+    core.tick({b: (sb[half][0], sb[half][1], None)})
+    drive(core, {a: sa[len(sa) // 2:], b: sb[half + 1:]})
+    for s, script in ((a, sa), (b, sb)):
+        spec = make_singleton(spec=True)
+        for reqs, confirmed in script:
+            spec.tick(reqs, confirmed, None)
+        assert_slot_equals_runner(core, s, spec)
+
+
+def test_extract_readmit_bitwise_and_recompile_free():
+    """Drain a slot mid-trajectory to a ticket, route it through a
+    singleton runner (the recovery-lane move), readmit at the same traced
+    slot index, finish the script: bitwise parity with the uninterrupted
+    run and ZERO compiles through the whole churn."""
+    assert xla_cache.install_compile_listeners()
+    core = make_core(num_slots=2)
+    s = core.admit()
+    script = make_script(seed=21, depth=3, cycles=4)
+    third = len(script) // 3
+    drive(core, {s: script[:third]})
+    # Lane stand-in, pre-warmed: the server warms its shared lane
+    # executable at warmup() time, so it's outside the churn window.
+    runner = make_singleton(spec=False)
+    base = xla_cache.compile_counters()["backend_compiles"]
+    cache0 = core._exec.cache_size()
+
+    ticket = core.extract(s)
+    assert not core.slots[s].active
+    adopt_ticket(runner, ticket)
+    for reqs, _ in script[third: 2 * third]:
+        runner.handle_requests(reqs, None)
+    back = SlotTicket(
+        frame=runner.frame, state=runner.state, ring=runner.ring,
+        input_log=dict(runner._input_log or {}),
+    )
+    assert core.admit(slot=s, ticket=back) == s
+    drive(core, {s: script[2 * third:]})
+
+    assert xla_cache.compile_counters()["backend_compiles"] == base
+    assert core._exec.cache_size() == cache0 == 1
+    spec = make_singleton(spec=True)
+    for reqs, confirmed in script:
+        spec.tick(reqs, confirmed, None)
+    assert_slot_equals_runner(core, s, spec)
+
+
+def test_slot_health_fsm_legality():
+    fsm = SlotHealthFSM(0, strike_limit=3)
+    assert fsm.state is SlotHealth.HEALTHY
+    # Strike path: degrade on the first miss, trip at the limit.
+    assert not fsm.strike(10)
+    assert fsm.state is SlotHealth.DEGRADED
+    fsm.clear()  # one good tick forgives the streak
+    assert (fsm.state, fsm.strikes) == (SlotHealth.HEALTHY, 0)
+    assert not fsm.strike(11) and not fsm.strike(12)
+    assert fsm.strike(13)
+    fsm.to(SlotHealth.QUARANTINED, reason="watchdog_timeout", frame=13)
+    assert fsm.last_reason == "watchdog_timeout"
+    assert fsm.last_fault_frame == 13 and fsm.strikes == 0
+    with pytest.raises(ValueError):
+        fsm.to(SlotHealth.HEALTHY)  # must pass through RECOVERING
+    fsm.to(SlotHealth.RECOVERING)
+    fsm.to(SlotHealth.HEALTHY)
+    fsm.to(SlotHealth.QUARANTINED)
+    fsm.to(SlotHealth.EVICTED)
+    for state in SlotHealth:
+        if state is SlotHealth.EVICTED:
+            continue
+        with pytest.raises(ValueError):
+            fsm.to(state)  # EVICTED is terminal
+
+
+# ---------------------------------------------------------------------------
+# MatchServer: quarantine -> lane -> readmit
+# ---------------------------------------------------------------------------
+
+
+def make_server(metrics=None, clock=None, **kw):
+    kw.setdefault("capacity", 4)
+    kw.setdefault("stagger_groups", 2)
+    if clock is not None:
+        kw["clock"] = clock
+    server = MatchServer(
+        box_game.make_schedule(), box_game.make_world(P).commit(),
+        MAXPRED, P, box_game.INPUT_SPEC,
+        num_branches=BRANCHES, spec_frames=SPEC_FRAMES, metrics=metrics,
+        **kw,
+    )
+    server.warmup()
+    return server
+
+
+def make_synctest():
+    return (
+        SessionBuilder(box_game.INPUT_SPEC)
+        .with_num_players(P)
+        .with_max_prediction_window(MAXPRED)
+        .with_check_distance(2)
+        .start_synctest_session()
+    )
+
+
+def inputs_for(seed):
+    def f(frame, handle):
+        return np.uint8((frame * 3 + handle * 5 + seed) % 16)
+
+    return f
+
+
+class FlakySession:
+    """Delegating wrapper whose advance_frame raises exactly once, BEFORE
+    the inner session moves — the injected 'session crashed' fault."""
+
+    def __init__(self, inner, fail_at):
+        self._inner = inner
+        self._fail_at = fail_at
+        self.failed = False
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def advance_frame(self):
+        if not self.failed and self._inner.current_frame == self._fail_at:
+            self.failed = True
+            raise RuntimeError("injected session crash")
+        return self._inner.advance_frame()
+
+
+class HungSession:
+    """Delegating wrapper that burns fake-clock time inside advance_frame
+    for a window of frames — the deliberately-hung session the watchdog
+    must fence."""
+
+    def __init__(self, inner, clk, hang_frames, hang_s=0.2):
+        self._inner = inner
+        self._clk = clk
+        self._hang = set(hang_frames)
+        self._hang_s = hang_s
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def advance_frame(self):
+        if self._inner.current_frame in self._hang:
+            self._clk[0] += self._hang_s
+        return self._inner.advance_frame()
+
+
+def test_server_session_error_isolated_readmitted_no_recompile():
+    """A session that raises mid-match is quarantined, recovers on a lane,
+    readmits at its reserved slot — and the WHOLE incident is invisible:
+    every match (faulted included) ends bitwise identical to a fault-free
+    control server, with a compile-counter delta of zero."""
+    from bevy_ggrs_tpu.obs.recorder import FlightRecorder
+
+    assert xla_cache.install_compile_listeners()
+    metrics = Metrics()
+    server = make_server(metrics=metrics)
+    control = make_server()
+    handles = [
+        server.add_match(FlakySession(make_synctest(), fail_at=5),
+                         inputs_for(9)),
+        server.add_match(make_synctest(), inputs_for(1)),
+        server.add_match(make_synctest(), inputs_for(2)),
+    ]
+    c_handles = [
+        control.add_match(make_synctest(), inputs_for(9)),
+        control.add_match(make_synctest(), inputs_for(1)),
+        control.add_match(make_synctest(), inputs_for(2)),
+    ]
+    for _ in range(4):
+        server.run_frame()
+        control.run_frame()
+    base = xla_cache.compile_counters()["backend_compiles"]
+    rec = FlightRecorder()
+    recovering_seen = 0
+    for _ in range(11):
+        server.run_frame()
+        control.run_frame()
+        recovering_seen += rec.capture(server=server).slots_recovering
+    assert server.faults_total == 1
+    assert server.readmissions_total == 1
+    assert recovering_seen >= 1  # the gauge column actually moved
+    assert server.last_recovery_frames is not None
+    assert 0 < server.last_recovery_frames <= 8
+    assert metrics.counters["slot_faults"] == 1
+    bad = server._matches[handles[0]]
+    assert bad.fsm.state is SlotHealth.HEALTHY
+    assert bad.fsm.last_reason == "session_error"
+    # Bitwise vs the fault-free control, every match, same frame count.
+    assert xla_cache.compile_counters()["backend_compiles"] == base
+    assert server.cache_size() == 1
+    for h, c in zip(handles, c_handles):
+        core, ctrl = server.groups[h.group], control.groups[c.group]
+        assert core.slots[h.slot].frame == ctrl.slots[c.slot].frame == 15
+        assert slot_cs(core, h.slot) == slot_cs(ctrl, c.slot)
+
+
+def test_server_watchdog_fences_hung_session():
+    """A session that blows its host-tick budget ``strike_limit`` frames
+    running gets DEGRADED strikes, then quarantined with its in-hand
+    requests riding to the lane — while the healthy sibling never misses a
+    frame. A single slow tick (one strike, then clean) is forgiven."""
+    clk = [0.0]
+    metrics = Metrics()
+    server = make_server(metrics=metrics, clock=lambda: clk[0],
+                         watchdog_budget_ms=50.0, watchdog_strike_limit=3)
+    hung = server.add_match(
+        HungSession(make_synctest(), clk, hang_frames={4, 5, 6}),
+        inputs_for(3),
+    )
+    blip = server.add_match(
+        HungSession(make_synctest(), clk, hang_frames={2}), inputs_for(4)
+    )
+    ok = server.add_match(make_synctest(), inputs_for(5))
+    for _ in range(4):
+        server.run_frame()
+    assert server.health_of(hung) is SlotHealth.HEALTHY
+    server.run_frame()  # frame 4: first miss -> DEGRADED
+    assert server.health_of(hung) is SlotHealth.DEGRADED
+    assert server.faults_total == 0
+    for _ in range(7):
+        server.run_frame()
+    assert server.faults_total == 1
+    assert server.readmissions_total == 1
+    m = server._matches[hung]
+    assert m.fsm.state is SlotHealth.HEALTHY
+    assert m.fsm.last_reason == "watchdog_timeout"
+    strikes = sum(
+        v for k, v in metrics.counters.items()
+        if k.startswith("watchdog_strikes")
+    )
+    assert strikes >= 4
+    # One slow tick never faulted: strike -> clean wiped the streak.
+    assert server.health_of(blip) is SlotHealth.HEALTHY
+    # The healthy sibling kept full cadence through the incident.
+    assert server.groups[ok.group].slots[ok.slot].frame == 12
+    # The hung match lost no frames either: its in-flight requests rode
+    # to the lane (pending) so session and runner stayed converged.
+    sess = server._matches[hung].session
+    assert sess.current_frame >= 12
+
+
+def test_server_suspend_resume_same_match():
+    """Voluntary drain of THE SAME match: suspend_match hands back a
+    ticket, other matches keep running, resume_match readmits it (same
+    session object) and it finishes bitwise where an uninterrupted match
+    with the same input script would."""
+    server = make_server()
+    ref = make_server()
+    sess = make_synctest()
+    h = server.add_match(sess, inputs_for(7))
+    other = server.add_match(make_synctest(), inputs_for(8))
+    r = ref.add_match(make_synctest(), inputs_for(7))
+    for _ in range(6):
+        server.run_frame()
+        ref.run_frame()
+    ticket = server.suspend_match(h)
+    assert ticket.frame == 6
+    assert server.slots_active == 1
+    for _ in range(4):
+        server.run_frame()  # the other match runs on while h is parked
+    h2 = server.resume_match(sess, inputs_for(7), ticket)
+    for _ in range(6):
+        server.run_frame()
+        ref.run_frame()
+    core = server.groups[h2.group]
+    assert core.slots[h2.slot].frame == 12
+    assert ref.groups[r.group].slots[r.slot].frame == 12
+    assert slot_cs(core, h2.slot) == slot_cs(ref.groups[r.group], r.slot)
+    assert server.groups[other.group].slots[other.slot].frame == 16
+
+
+def test_server_retire_then_fresh_admit_reuses_slot():
+    """retire_match -> add_match cycles a slot: the newcomer starts at
+    frame 0 with none of the retired match's log/spec state leaking."""
+    server = make_server(capacity=2, stagger_groups=1)
+    ref = make_server(capacity=2, stagger_groups=1)
+    h0 = server.add_match(make_synctest(), inputs_for(1))
+    for _ in range(9):
+        server.run_frame()
+    server.retire_match(h0)
+    assert server.slots_active == 0 and server.slots_free == 2
+    h1 = server.add_match(make_synctest(), inputs_for(2))
+    assert h1.slot == h0.slot  # the freed slot is handed out again
+    r = ref.add_match(make_synctest(), inputs_for(2))
+    for _ in range(9):
+        server.run_frame()
+        ref.run_frame()
+    core = server.groups[h1.group]
+    assert core.slots[h1.slot].frame == 9
+    assert slot_cs(core, h1.slot) == slot_cs(ref.groups[r.group], r.slot)
+
+
+# ---------------------------------------------------------------------------
+# Crash-restart checkpoints
+# ---------------------------------------------------------------------------
+
+
+def test_checkpointer_save_restore_bitwise(tmp_path):
+    """kill -9 drill for synctest matches: run a checkpointing server,
+    drop it, rebuild from construction parameters + the newest checkpoint,
+    and (a) every match resumes at its exact (group, slot) with bitwise-
+    identical state, (b) the resumed trajectory stays bitwise equal to an
+    uninterrupted reference run."""
+    ckpt = str(tmp_path / "ckpts")
+    server = make_server(checkpoint_dir=ckpt, checkpoint_interval=6,
+                         checkpoint_keep=2)
+    ref = make_server()
+    seeds = (11, 12, 13)
+    handles = [server.add_match(make_synctest(), inputs_for(k))
+               for k in seeds]
+    r_handles = [ref.add_match(make_synctest(), inputs_for(k))
+                 for k in seeds]
+    for _ in range(12):
+        server.run_frame()
+        ref.run_frame()
+    assert server.checkpointer.saves_total == 2  # frames 6 and 12
+    want = {
+        h: (server.groups[h.group].slots[h.slot].frame,
+            slot_cs(server.groups[h.group], h.slot))
+        for h in handles
+    }
+    del server  # the crash
+
+    revived = make_server(checkpoint_dir=ckpt, checkpoint_interval=6,
+                          checkpoint_keep=2)
+    attachments = {
+        (h.group, h.slot): {"session": make_synctest(),
+                            "local_inputs": inputs_for(k)}
+        for h, k in zip(handles, seeds)
+    }
+    restored = revived.checkpointer.restore(revived, attachments)
+    assert {(h.group, h.slot) for h in restored} == set(attachments)
+    for h in handles:
+        frame, cs = want[h]
+        core = revived.groups[h.group]
+        assert core.slots[h.slot].frame == frame == 12
+        assert slot_cs(core, h.slot) == cs
+    for _ in range(6):
+        revived.run_frame()
+        ref.run_frame()
+    for h, r in zip(handles, r_handles):
+        core, rc = revived.groups[h.group], ref.groups[r.group]
+        assert core.slots[h.slot].frame == rc.slots[r.slot].frame == 18
+        assert slot_cs(core, h.slot) == slot_cs(rc, r.slot)
+
+
+def test_checkpointer_guards(tmp_path):
+    server = make_server(checkpoint_dir=str(tmp_path), checkpoint_interval=4)
+    server.add_match(make_synctest(), inputs_for(1))
+    for _ in range(4):
+        server.run_frame()
+    path = server.checkpointer.latest()
+    assert path is not None
+    fresh = make_server(checkpoint_dir=str(tmp_path))
+    with pytest.raises(ValueError, match="no attachment"):
+        fresh.checkpointer.restore(fresh, {})
+    with pytest.raises(ValueError):
+        ServerCheckpointer(str(tmp_path), interval=0)
+    # Rolling window: old checkpoints are pruned to ``keep``.
+    server2 = make_server(checkpoint_dir=str(tmp_path / "k"),
+                          checkpoint_interval=2, checkpoint_keep=2)
+    server2.add_match(make_synctest(), inputs_for(2))
+    for _ in range(8):
+        server2.run_frame()
+    assert server2.checkpointer.saves_total == 4
+    assert len(server2.checkpointer._checkpoints()) == 2
