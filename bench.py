@@ -1523,6 +1523,19 @@ _MULTIHOST_CONFIGS = ("live_multihost_2proc_spmd",)
 _RELAY_CONFIGS = ("relay_fanout_64spec",)
 
 
+def _bench_trace_dir(config: str):
+    """Per-config telemetry directory under ``--trace-dir`` /
+    ``GGRS_TRACE_DIR`` (None when tracing is off). Every soak/bench entry
+    that owns a process dumps its per-process trace + provenance exports
+    here, ready for ``python -m bevy_ggrs_tpu.obs.merge``."""
+    base = os.environ.get("GGRS_TRACE_DIR")
+    if not base:
+        return None
+    d = os.path.join(base, config)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
 def _relay_fanout_case() -> dict:
     """A live 2-peer match terminated entirely by a RelayServer, its
     confirmed-state stream published ONCE and fanned out to S=64
@@ -1553,9 +1566,34 @@ def _relay_fanout_case() -> dict:
     settle = 120  # post-subscribe frames excluded from the lag samples
     net = LoopbackNetwork()
     relay_metrics = Metrics()
+    # --trace-dir: passive provenance taps on the raw sockets + a span
+    # tracer on the relay, exported (plus a pre-merged timeline) for the
+    # obs/merge.py workflow. The taps transmit nothing, so the measured
+    # pump costs stay honest.
+    td = _bench_trace_dir("relay_fanout_64spec")
+    sidecars = []
+    relay_tracer = None
+
+    def tap(sock, component, pid):
+        if td is None:
+            return sock
+        from bevy_ggrs_tpu.obs import ProvenanceLog, SidecarSocket
+
+        log = ProvenanceLog(component, pid=pid, clock=lambda: net.now)
+        sidecars.append(log)
+        return SidecarSocket(sock, log)
+
+    relay_sock = tap(net.socket(("relay", 0)), "relay", 100)
+    if td is not None:
+        from bevy_ggrs_tpu.obs import SpanTracer
+
+        relay_tracer = SpanTracer(
+            clock=lambda: net.now, pid=100, process_name="relay"
+        )
     relay = RelayServer(
-        net.socket(("relay", 0)), clock=lambda: net.now,
+        relay_sock, clock=lambda: net.now,
         metrics=relay_metrics, max_subscribers=max(S, 4096),
+        tracer=relay_tracer,
     )
 
     def scripted(handle, frame):
@@ -1566,7 +1604,8 @@ def _relay_fanout_case() -> dict:
     peers = []
     for me in range(P):
         rsock = RelaySocket(
-            net.socket(("peer", me)), [("relay", 0)],
+            tap(net.socket(("peer", me)), f"peer{me}", me),
+            [("relay", 0)],
             session_id=1, peer_id=me, clock=lambda: net.now,
         )
         builder = (
@@ -1645,6 +1684,21 @@ def _relay_fanout_case() -> dict:
     spectators_per_core = (
         int((1000.0 * _DT) / per_spec_ms) if within_bound else S
     )
+    if td is not None:
+        from bevy_ggrs_tpu.obs import merge_traces
+
+        trace_paths, prov_paths = [], []
+        p = os.path.join(td, "relay_trace.json")
+        relay_tracer.export_perfetto(p)
+        trace_paths.append(p)
+        for log in sidecars:
+            p = os.path.join(td, f"{log.component}_provenance.jsonl")
+            log.export_jsonl(p)
+            prov_paths.append(p)
+        merge_traces(
+            trace_paths, prov_paths,
+            path=os.path.join(td, "merged_trace.json"),
+        )
     return _entry(
         "relay_fanout_64spec",
         max(float(np.percentile(np.asarray(pump_ms_full), 99)), 1e-3),
@@ -1746,9 +1800,19 @@ def _serve_batched_case(model: str, S: int) -> dict:
     rtt0 = _host_device_rtt_ms()
     xla_cache.install_compile_listeners()
 
+    from bevy_ggrs_tpu.obs import AttributionProbe, profile_window
+
+    td = _bench_trace_dir(f"serve_batched_{model}_S{S}")
+    tracer = None
+    if td is not None:
+        from bevy_ggrs_tpu.obs import SpanTracer
+
+        tracer = SpanTracer(pid=0, process_name=f"serve_{model}_S{S}")
+
     core = BatchedSessionCore(
         schedule, initial, MAXPRED, P, input_spec, num_slots=S,
         num_branches=B, spec_frames=F,
+        **({"tracer": tracer} if tracer is not None else {}),
     )
     core.warmup()
     slots = [core.admit() for _ in range(S)]
@@ -1757,16 +1821,27 @@ def _serve_batched_case(model: str, S: int) -> dict:
         core.tick({s: scripts[s][t] + (None,) for s in slots})
     jax.block_until_ready(core.states)
 
+    # Host/device attribution (obs/attribution.py): the tick loop times
+    # the enqueue side (host: branch build, argument assembly, driver),
+    # block_until_ready times the residual device wait. A matching probe
+    # on the serial singleton below calibrates the lane-serialization
+    # verdict. GGRS_PROFILE_DIR additionally wraps the timed windows in a
+    # jax.profiler capture for kernel-level detail.
+    probe = AttributionProbe()
     times = []
     t_idx = warm
-    while t_idx + window <= ticks:
-        t0 = time.perf_counter()
-        for t in range(t_idx, t_idx + window):
-            core.tick({s: scripts[s][t] + (None,) for s in slots})
-        jax.block_until_ready(core.states)
-        times.append((time.perf_counter() - t0) * 1000.0 / window)
-        t_idx += window
+    with profile_window(os.environ.get("GGRS_PROFILE_DIR")):
+        while t_idx + window <= ticks:
+            t0 = time.perf_counter()
+            with probe.host():
+                for t in range(t_idx, t_idx + window):
+                    core.tick({s: scripts[s][t] + (None,) for s in slots})
+            with probe.device_wait():
+                jax.block_until_ready(core.states)
+            times.append((time.perf_counter() - t0) * 1000.0 / window)
+            t_idx += window
     ran = t_idx  # ticks actually driven (warm + whole windows)
+    probe.snapshot_compiles()  # parity/churn/serial compiles are theirs
     tick_p50 = float(np.percentile(times, 50))
     tick_p99 = float(np.percentile(times, 99))
 
@@ -1826,15 +1901,37 @@ def _serve_batched_case(model: str, S: int) -> dict:
         serial.tick(*sscript[t], None)
     jax.block_until_ready(serial.state)
     stimes = []
+    sprobe = AttributionProbe()
     t_idx = warm
     while t_idx + window <= sticks:
         t0 = time.perf_counter()
-        for t in range(t_idx, t_idx + window):
-            serial.tick(*sscript[t], None)
-        jax.block_until_ready(serial.state)
+        with sprobe.host():
+            for t in range(t_idx, t_idx + window):
+                serial.tick(*sscript[t], None)
+        with sprobe.device_wait():
+            jax.block_until_ready(serial.state)
         stimes.append((time.perf_counter() - t0) * 1000.0 / window)
         t_idx += window
     serial_per_match = float(np.percentile(stimes, 50))
+
+    # The verdict: host_bound / device_bound / balanced / lane_serialized
+    # (batched device wait ~= S x the serial singleton's device wait —
+    # measured, not asserted).
+    serial_device = sprobe.device_ms / max(sprobe.dispatches, 1)
+    attribution = probe.result(lanes=S, serial_device_ms=serial_device)
+    attribution["attr_serial_device_ms"] = round(serial_device, 4)
+
+    if td is not None:
+        from bevy_ggrs_tpu.obs import build_report
+
+        if tracer is not None:
+            tracer.export_perfetto(os.path.join(td, "serve_trace.json"))
+        build_report(
+            os.path.join(td, "serve_report.html"),
+            title=f"serve_batched_{model}_S{S}",
+            tracers={} if tracer is None else {"serve": tracer},
+            attribution={f"serve_batched_{model}_S{S}": attribution},
+        )
 
     per_match = tick_p50 / S
     frame_ms = 1000.0 / 60.0
@@ -1855,6 +1952,7 @@ def _serve_batched_case(model: str, S: int) -> dict:
         parity_slots_checked=len(sample),
         churn_recompiles=int(churn_recompiles),
         cache_size_stable=bool(core._exec.cache_size() == cache0),
+        **attribution,
         notes=(
             "spec-ON, depth-2 rollback every 6th tick on every match; "
             "capacity gated on desyncs == 0 (bitwise serial-replay parity) "
@@ -2080,6 +2178,9 @@ def _serve_chaos_case(S: int) -> dict:
                 np.percentile(vals, 99)
             )
             recovery_cols[f"recovery_events_{reason}"] = len(vals)
+        td = _bench_trace_dir(f"serve_chaos_S{S}")
+        if td is not None:
+            server.export_telemetry(td, prefix=f"serve_chaos_S{S}")
         return _entry(
             f"serve_chaos_S{S}",
             healthy_p50, S, B,
@@ -2249,6 +2350,17 @@ def _write_detail(platform, detail) -> None:
 
 def main() -> None:
     args = sys.argv[1:]
+    if "--trace-dir" in args:
+        # Per-process telemetry root: every config that owns a process
+        # dumps trace/provenance/report artifacts under
+        # <trace-dir>/<config>/ (obs/merge.py stitches them). Exported
+        # through the env so run_matrix subprocesses inherit it.
+        idx = args.index("--trace-dir") + 1
+        if idx >= len(args):
+            print("bench: --trace-dir needs a path", file=sys.stderr)
+            raise SystemExit(2)
+        os.environ["GGRS_TRACE_DIR"] = os.path.abspath(args[idx])
+        args = args[: idx - 1] + args[idx + 1:]
     if "--multihost-worker" in args:
         # Child of _live_multihost_case — configures its OWN 4-device CPU
         # backend, so it must run before any _ensure_backend() touch.

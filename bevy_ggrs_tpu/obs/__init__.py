@@ -21,9 +21,14 @@ Quick start::
     obs.export_prometheus(metrics, recorder)      # -> text exposition
 """
 
+from .attribution import AttributionProbe, profile_window
 from .forensics import DesyncForensics, desync_report
+from .merge import follow, frame_flows, merge_traces
 from .prom import export_prometheus
+from .provenance import ProvenanceLog, SidecarSocket, flow_key
 from .recorder import FlightRecorder, FrameRecord
+from .report import build_report
+from .slo import SLOConfig, SlotSLO
 from .trace import SpanTracer, null_tracer
 
 
@@ -33,12 +38,23 @@ def export_perfetto(tracer, path=None):
 
 
 __all__ = [
+    "AttributionProbe",
     "DesyncForensics",
     "FlightRecorder",
     "FrameRecord",
+    "ProvenanceLog",
+    "SLOConfig",
+    "SidecarSocket",
+    "SlotSLO",
     "SpanTracer",
+    "build_report",
     "desync_report",
     "export_perfetto",
     "export_prometheus",
+    "flow_key",
+    "follow",
+    "frame_flows",
+    "merge_traces",
     "null_tracer",
+    "profile_window",
 ]

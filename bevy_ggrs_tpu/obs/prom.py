@@ -23,12 +23,20 @@ suffix, so per-slot serving metrics export as proper labeled samples
 (``ggrs_frames_advanced_total{match_slot="3"} 42``) instead of being
 mangled into one flat name per label set. ``# TYPE`` is emitted once per
 metric family, not once per label set.
+
+Label values are escaped per the text-format spec (backslash, double
+quote, newline) at *encode* time — ``Metrics`` builds its keys through
+:func:`escape_label_value`, so the blocks this exporter preserves are
+already valid exposition. Any label value this module emits itself must
+go through the same helper.
 """
 
 from __future__ import annotations
 
 import re
 from typing import Optional
+
+from ..utils.metrics import escape_label_value  # noqa: F401  (re-export)
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 

@@ -1,0 +1,216 @@
+"""Self-contained HTML ops report: the artifact CI uploads on failure.
+
+One file, zero external assets, loadable from an artifact zip in any
+browser. It assembles what the obs stack already collects:
+
+- per-slot SLO state (level + burn rates per objective),
+- span summaries per component tracer,
+- the flight-recorder tail (last N frames per recorder) and the
+  rollback-depth histogram,
+- host/device attribution rows from benches,
+- the raw metrics summary,
+
+so a failed soak ships its own forensics viewer instead of a directory
+of JSONL files someone has to re-tool over. Everything is optional: the
+report renders whatever subset the caller has.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import time
+from typing import Dict, Iterable, Optional
+
+_CSS = """
+body { font: 13px/1.45 system-ui, sans-serif; margin: 1.5em; color: #222; }
+h1 { font-size: 1.3em; } h2 { font-size: 1.05em; margin-top: 1.6em;
+  border-bottom: 1px solid #ddd; padding-bottom: .2em; }
+table { border-collapse: collapse; margin: .5em 0; }
+th, td { border: 1px solid #ccc; padding: .2em .55em; text-align: right; }
+th { background: #f2f2f2; } td.l, th.l { text-align: left; }
+.ok { background: #e6f4e6; } .warn { background: #fff3cd; }
+.page { background: #f8d7da; font-weight: 600; }
+.small { color: #777; font-size: .92em; }
+pre { background: #f7f7f7; padding: .6em; overflow-x: auto; }
+"""
+
+
+def _esc(v) -> str:
+    return html.escape(str(v))
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return str(int(v)) if v.is_integer() else f"{v:.3f}"
+    return str(v)
+
+
+def _table(headers: Iterable[str], rows: Iterable[Iterable], left=1) -> str:
+    h = "".join(
+        f'<th class="l">{_esc(c)}</th>' if i < left else f"<th>{_esc(c)}</th>"
+        for i, c in enumerate(headers)
+    )
+    body = []
+    for row in rows:
+        cells = []
+        cls = ""
+        for i, c in enumerate(row):
+            if isinstance(c, tuple):  # (value, css-class)
+                c, cls = c
+            k = ' class="l"' if i < left else (f' class="{cls}"' if cls else "")
+            cells.append(f"<td{k}>{_esc(_fmt(c))}</td>")
+            cls = ""
+        body.append("<tr>" + "".join(cells) + "</tr>")
+    return f"<table><tr>{h}</tr>{''.join(body)}</table>"
+
+
+def _slo_section(slo_snapshot: dict) -> str:
+    slots = slo_snapshot.get("slots", {})
+    if not slots:
+        return "<p class='small'>no SLO samples</p>"
+    rows = []
+    for slot, st in sorted(slots.items(), key=lambda kv: int(kv[0])):
+        lvl = st.get("level", "ok")
+        row = [f"slot {slot}", (lvl, lvl)]
+        for name in ("deadline", "rollback", "recovery", "quarantine"):
+            obj = st.get("objectives", {}).get(name, {})
+            row.append(f"{obj.get('short_burn', 0.0):.2f}")
+            row.append(f"{obj.get('long_burn', 0.0):.2f}")
+        rows.append(row)
+    headers = ["slot", "level"]
+    for name in ("deadline", "rollback", "recovery", "quarantine"):
+        headers += [f"{name} s-burn", f"{name} l-burn"]
+    cfg = slo_snapshot.get("config", {})
+    return (
+        _table(headers, rows, left=1)
+        + f"<p class='small'>config: {_esc(json.dumps(cfg))}</p>"
+    )
+
+
+def _spans_section(tracers: Dict[str, object]) -> str:
+    parts = []
+    for comp, tracer in sorted(tracers.items()):
+        summ = tracer.summary() if hasattr(tracer, "summary") else dict(tracer)
+        if not summ:
+            continue
+        rows = [
+            [name, s["count"], f"{s['total_ms']:.2f}",
+             f"{s['mean_ms']:.3f}", f"{s['max_ms']:.3f}"]
+            for name, s in sorted(summ.items())
+        ]
+        parts.append(f"<h3>{_esc(comp)}</h3>")
+        parts.append(
+            _table(["span", "count", "total ms", "mean ms", "max ms"], rows)
+        )
+    return "".join(parts) or "<p class='small'>no spans</p>"
+
+
+def _recorder_section(recorders: Dict[str, object], tail: int = 40) -> str:
+    parts = []
+    for comp, rec in sorted(recorders.items()):
+        records = list(getattr(rec, "records", lambda: rec)())
+        hist = (
+            rec.rollback_histogram()
+            if hasattr(rec, "rollback_histogram") else {}
+        )
+        if hist:
+            parts.append(f"<h3>{_esc(comp)} rollback depth</h3>")
+            parts.append(
+                _table(
+                    ["depth", "frames"],
+                    [[d, hist[d]] for d in sorted(hist)],
+                )
+            )
+        if records:
+            last = records[-tail:]
+            fields = [
+                f for f in (
+                    "frame", "confirmed_frame", "rollback_depth",
+                    "slots_active", "slots_quarantined", "slots_recovering",
+                    "stagger_jitter_ms",
+                )
+                if any(getattr(r, f, None) is not None for r in last)
+            ]
+            rows = [
+                [getattr(r, f, "") if getattr(r, f, None) is not None else ""
+                 for f in fields]
+                for r in last
+            ]
+            parts.append(
+                f"<h3>{_esc(comp)} flight-recorder tail "
+                f"({len(last)}/{len(records)} frames)</h3>"
+            )
+            parts.append(_table(fields, rows, left=0))
+    return "".join(parts) or "<p class='small'>no flight-recorder data</p>"
+
+
+def _attribution_section(attribution: Dict[str, dict]) -> str:
+    if not attribution:
+        return "<p class='small'>no attribution rows</p>"
+    keys = sorted({k for row in attribution.values() for k in row})
+    rows = [
+        [name] + [row.get(k, "") for k in keys]
+        for name, row in sorted(attribution.items())
+    ]
+    return _table(["bench"] + keys, rows)
+
+
+def _metrics_section(metrics) -> str:
+    summ = metrics.summary() if hasattr(metrics, "summary") else dict(metrics)
+    if not summ:
+        return "<p class='small'>no metrics</p>"
+    rows = []
+    for name, stats in sorted(summ.items()):
+        body = " ".join(f"{k}={_fmt(v)}" for k, v in stats.items())
+        rows.append([name, body])
+    return _table(["metric", "stats"], rows, left=2)
+
+
+def build_report(
+    path: Optional[str] = None,
+    *,
+    title: str = "ggrs ops report",
+    slo=None,
+    tracers: Optional[Dict[str, object]] = None,
+    recorders: Optional[Dict[str, object]] = None,
+    attribution: Optional[Dict[str, dict]] = None,
+    metrics=None,
+    notes: Optional[str] = None,
+) -> str:
+    """Render the report; write it to ``path`` when given. ``slo`` is a
+    :class:`~bevy_ggrs_tpu.obs.slo.SlotSLO` or its ``snapshot()`` dict;
+    ``tracers`` / ``recorders`` map component name -> object;
+    ``attribution`` maps bench name -> attribution row dict."""
+    sections = []
+    if notes:
+        sections.append(f"<p>{_esc(notes)}</p>")
+    if slo is not None:
+        snap = slo.snapshot() if hasattr(slo, "snapshot") else dict(slo)
+        sections.append("<h2>Slot SLO state</h2>" + _slo_section(snap))
+    if attribution:
+        sections.append(
+            "<h2>Device-time attribution</h2>"
+            + _attribution_section(attribution)
+        )
+    if tracers:
+        sections.append("<h2>Span summaries</h2>" + _spans_section(tracers))
+    if recorders:
+        sections.append(
+            "<h2>Flight recorder</h2>" + _recorder_section(recorders)
+        )
+    if metrics is not None:
+        sections.append("<h2>Metrics</h2>" + _metrics_section(metrics))
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
+    doc = (
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        f"<title>{_esc(title)}</title><style>{_CSS}</style></head><body>"
+        f"<h1>{_esc(title)}</h1>"
+        f"<p class='small'>generated {stamp}</p>"
+        + "".join(sections)
+        + "</body></html>"
+    )
+    if path is not None:
+        with open(path, "w") as f:
+            f.write(doc)
+    return doc

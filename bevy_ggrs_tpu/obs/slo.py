@@ -1,0 +1,205 @@
+"""Slot SLO engine: windowed objectives + multi-window burn-rate alerts.
+
+`serve/faults.py` gave each slot a health FSM, but its only *input* is
+the watchdog (a per-tick boolean). A fleet balancer needs rates: a slot
+that misses its frame deadline 2% of the time is fine for one tick and
+fatal over an hour. This module keeps small per-slot sample windows and
+reduces them to the standard SRE signal — error-budget **burn rate**
+(bad fraction divided by allowed fraction) over a short and a long
+window — so one number says "how fast is this slot spending its budget".
+
+Objectives per slot (all windowed, all configurable):
+
+==============  ====================================================
+deadline        fraction of ticks inside the frame budget
+rollback        fraction of ticks whose rollback depth stays <= limit
+recovery        fraction of ticks with recovery debt <= limit frames
+quarantine      duty-cycle bound: fraction of ticks NOT quarantined
+==============  ====================================================
+
+Alert levels follow the multi-window pattern (fast burn on BOTH windows
+pages; slow burn on the long window warns), which is robust to the two
+classic failure modes: a single bad tick never pages (short window alone
+is noisy), and a slow leak can't hide (long window catches it).
+
+Outputs:
+
+- :meth:`SlotSLO.level` -> ``"ok" | "warn" | "page"`` per slot, which
+  :meth:`~bevy_ggrs_tpu.serve.faults.SlotHealthFSM.slo_signal` consumes
+  (a paging slot is driven to DEGRADED even when every individual tick
+  passed the watchdog; a recovered budget clears it);
+- labeled Prometheus exposition through the existing ``Metrics`` path
+  (``slo_burn{match_slot,objective}`` series + level-transition
+  counters), bounded by the label-cardinality guard;
+- :meth:`SlotSLO.snapshot` for the HTML ops report.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Deque, Dict, Optional
+
+from ..utils.metrics import null_metrics
+
+LEVEL_OK = "ok"
+LEVEL_WARN = "warn"
+LEVEL_PAGE = "page"
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    # Objectives: allowed good-fraction per window.
+    deadline_objective: float = 0.99
+    rollback_objective: float = 0.95
+    recovery_objective: float = 0.95
+    quarantine_objective: float = 0.80  # <= 20% duty cycle quarantined
+    # What counts as a bad tick.
+    rollback_depth_limit: int = 6   # frames resimulated in one tick
+    recovery_debt_limit: int = 30   # frames behind the group head
+    # Windows are in ticks (the server tick IS the sampling clock).
+    short_window: int = 64
+    long_window: int = 512
+    # Burn thresholds (SRE convention: burn 1.0 = spending exactly the
+    # error budget; 14.4 = a 30-day budget gone in 2 days).
+    fast_burn: float = 14.4
+    slow_burn: float = 6.0
+    # Minimum samples before a window is trusted (no paging on 3 ticks).
+    min_samples: int = 16
+
+
+_OBJECTIVES = ("deadline", "rollback", "recovery", "quarantine")
+
+
+class _SlotWindow:
+    """Per-slot bounded rings of per-tick bad/good booleans."""
+
+    __slots__ = ("bad",)
+
+    def __init__(self, long_window: int):
+        self.bad: Dict[str, Deque[bool]] = {
+            name: collections.deque(maxlen=long_window)
+            for name in _OBJECTIVES
+        }
+
+
+class SlotSLO:
+    def __init__(
+        self,
+        config: Optional[SLOConfig] = None,
+        metrics=null_metrics,
+    ):
+        self.config = config or SLOConfig()
+        self.metrics = metrics
+        self._slots: Dict[int, _SlotWindow] = {}
+        self._levels: Dict[int, str] = {}
+
+    # -- sampling --------------------------------------------------------
+
+    def observe_tick(
+        self,
+        slot: int,
+        *,
+        deadline_ok: bool,
+        rollback_depth: int = 0,
+        recovery_debt: int = 0,
+        quarantined: bool = False,
+    ) -> None:
+        """Record one server tick for one slot."""
+        cfg = self.config
+        w = self._slots.get(slot)
+        if w is None:
+            w = self._slots[slot] = _SlotWindow(cfg.long_window)
+        w.bad["deadline"].append(not deadline_ok)
+        w.bad["rollback"].append(rollback_depth > cfg.rollback_depth_limit)
+        w.bad["recovery"].append(recovery_debt > cfg.recovery_debt_limit)
+        w.bad["quarantine"].append(bool(quarantined))
+
+    # -- reduction -------------------------------------------------------
+
+    def _objective(self, name: str) -> float:
+        return getattr(self.config, f"{name}_objective")
+
+    def burn_rates(self, slot: int) -> Dict[str, Dict[str, float]]:
+        """Per objective: bad fraction and burn rate over both windows.
+        Burn = bad_fraction / (1 - objective); 1.0 means the budget is
+        being spent exactly at the allowed rate."""
+        w = self._slots.get(slot)
+        out: Dict[str, Dict[str, float]] = {}
+        if w is None:
+            return out
+        short_n = self.config.short_window
+        for name in _OBJECTIVES:
+            ring = w.bad[name]
+            budget = max(1.0 - self._objective(name), 1e-9)
+            long_list = list(ring)
+            short_list = long_list[-short_n:]
+            stats = {}
+            for label, vals in (("short", short_list), ("long", long_list)):
+                n = len(vals)
+                frac = (sum(vals) / n) if n else 0.0
+                stats[f"{label}_n"] = n
+                stats[f"{label}_bad"] = frac
+                stats[f"{label}_burn"] = frac / budget
+            out[name] = stats
+        return out
+
+    def level(self, slot: int) -> str:
+        """Alert level for one slot: fast burn on BOTH windows -> page;
+        slow burn on the long window -> warn; else ok. Windows below
+        ``min_samples`` never alert."""
+        cfg = self.config
+        worst = LEVEL_OK
+        for stats in self.burn_rates(slot).values():
+            if stats["short_n"] < cfg.min_samples:
+                continue
+            if (
+                stats["short_burn"] >= cfg.fast_burn
+                and stats["long_burn"] >= cfg.fast_burn
+            ):
+                return LEVEL_PAGE
+            if stats["long_burn"] >= cfg.slow_burn:
+                worst = LEVEL_WARN
+        return worst
+
+    # -- export ----------------------------------------------------------
+
+    def export(self) -> Dict[int, str]:
+        """Push the current SLO state through the labeled metrics path
+        and return {slot: level}. Level *transitions* count (so the
+        exposition shows flap rates, not just the latest state)."""
+        levels: Dict[int, str] = {}
+        for slot in sorted(self._slots):
+            lab = {"match_slot": slot}
+            for name, stats in self.burn_rates(slot).items():
+                self.metrics.observe(
+                    "slo_burn_short", stats["short_burn"],
+                    labels={"match_slot": slot, "objective": name},
+                )
+            lvl = self.level(slot)
+            levels[slot] = lvl
+            prev = self._levels.get(slot)
+            if prev != lvl:
+                self._levels[slot] = lvl
+                self.metrics.count(
+                    "slo_level_transitions", 1,
+                    labels={"match_slot": slot, "to": lvl},
+                )
+            if lvl != LEVEL_OK:
+                self.metrics.count(
+                    "slo_not_ok_ticks", 1, labels=lab
+                )
+        return levels
+
+    def snapshot(self) -> Dict[str, object]:
+        """Full state for the ops report: per-slot levels + burn rates."""
+        return {
+            "config": dataclasses.asdict(self.config),
+            "slots": {
+                str(slot): {
+                    "level": self.level(slot),
+                    "objectives": self.burn_rates(slot),
+                }
+                for slot in sorted(self._slots)
+            },
+        }

@@ -74,6 +74,30 @@ class _Span:
         return False
 
 
+# Span-name prefix -> (track offset, track name). Export assigns each
+# component a stable tid (`tid * 4 + offset`) so a merged trace shows
+# session / spec / server / relay as separate named rows per process
+# instead of one flat track. Runtime order is globally LIFO (context
+# managers), and restricting a well-nested sequence to one component
+# keeps it well-nested, so per-(pid,tid) B/E matching holds without
+# restructuring the ring.
+_COMPONENT_TRACKS = (
+    ("spec", 1, "spec"),
+    ("serve", 2, "server"),
+    ("srv", 2, "server"),
+    ("relay", 3, "relay"),
+)
+_SESSION_TRACK = (0, "session")
+
+
+def _component_track(name: str):
+    head = name.split("_", 1)[0]
+    for prefix, offset, track in _COMPONENT_TRACKS:
+        if head == prefix:
+            return offset, track
+    return _SESSION_TRACK
+
+
 class SpanTracer:
     """Enabled tracer. ``pid`` distinguishes peers when several tracers'
     exports are merged into one trace (each peer is a Perfetto process)."""
@@ -87,6 +111,7 @@ class SpanTracer:
         pid: int = 0,
         tid: int = 0,
         process_name: Optional[str] = None,
+        wall_t0: Optional[float] = None,
     ):
         self._clock = clock
         self._origin = clock()
@@ -96,6 +121,10 @@ class SpanTracer:
         self.pid = int(pid)
         self.tid = int(tid)
         self.process_name = process_name
+        # Wall-clock instant of ts=0, so the merge tool can align traces
+        # captured by different processes (virtual-clock tracers share a
+        # timeline already; real-clock ones need this anchor).
+        self.wall_t0 = time.time() if wall_t0 is None else float(wall_t0)
 
     def _now_us(self) -> int:
         return int((self._clock() - self._origin) * 1e6)
@@ -165,21 +194,45 @@ class SpanTracer:
                     "args": {"name": self.process_name},
                 }
             )
+        named_tracks = set()
+        body = []
         for ph, name, ts, args in self._well_formed_events():
+            offset, track = _component_track(name)
+            tid = self.tid * 4 + offset
+            if tid not in named_tracks:
+                named_tracks.add(tid)
+                events.append(
+                    {
+                        "name": "thread_name",
+                        "ph": "M",
+                        "pid": self.pid,
+                        "tid": tid,
+                        "args": {"name": track},
+                    }
+                )
             ev = {
                 "name": name,
                 "cat": "ggrs",
                 "ph": "i" if ph == "I" else ph,
                 "ts": ts,
                 "pid": self.pid,
-                "tid": self.tid,
+                "tid": tid,
             }
             if ph == "I":
                 ev["s"] = "t"  # thread-scoped instant
             if args:
                 ev["args"] = dict(args)
-            events.append(ev)
-        trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+            body.append(ev)
+        events.extend(body)
+        trace = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "wall_t0": self.wall_t0,
+                "pid": self.pid,
+                "process_name": self.process_name,
+            },
+        }
         if path is not None:
             with open(path, "w") as f:
                 json.dump(trace, f)

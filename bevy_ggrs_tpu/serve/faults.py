@@ -192,6 +192,26 @@ class SlotHealthFSM:
         if self.state is SlotHealth.DEGRADED:
             self.to(SlotHealth.HEALTHY)
 
+    def slo_signal(self, level: str, frame: int = -1) -> None:
+        """Consume one SLO alert level (obs/slo.py) as a health input.
+
+        A ``"page"`` burn drives a HEALTHY slot to DEGRADED even though
+        no single tick tripped the watchdog — a slot missing 2% of
+        deadlines forever never strikes, but it IS spending error budget
+        the fleet balancer must see. An ``"ok"`` budget clears a
+        DEGRADED slot only when no watchdog strikes are live (strikes
+        own the DEGRADED state they created; the SLO must not mask an
+        in-progress streak). WARN is observability-only.
+        """
+        if level == "page" and self.state is SlotHealth.HEALTHY:
+            self.to(SlotHealth.DEGRADED, reason="slo_burn", frame=frame)
+        elif (
+            level == "ok"
+            and self.state is SlotHealth.DEGRADED
+            and self.strikes == 0
+        ):
+            self.to(SlotHealth.HEALTHY, reason="slo_recovered", frame=frame)
+
 
 @dataclasses.dataclass
 class SlotTicket:

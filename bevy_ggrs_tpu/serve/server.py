@@ -68,6 +68,7 @@ from bevy_ggrs_tpu.serve.faults import (
     adopt_ticket,
 )
 from bevy_ggrs_tpu.session.common import PredictionThreshold, SessionState
+from bevy_ggrs_tpu.session.requests import AdvanceFrame
 
 
 @dataclasses.dataclass(frozen=True)
@@ -120,7 +121,11 @@ class MatchServer:
         checkpoint_dir: Optional[str] = None,
         checkpoint_interval: int = 120,
         checkpoint_keep: int = 3,
+        slo_config=None,
+        slo_export_interval: int = 32,
+        trace_dir: Optional[str] = None,
     ):
+        from bevy_ggrs_tpu.obs.slo import SlotSLO
         from bevy_ggrs_tpu.obs.trace import null_tracer
         from bevy_ggrs_tpu.utils.metrics import null_metrics
         from bevy_ggrs_tpu.utils.xla_cache import (
@@ -194,6 +199,19 @@ class MatchServer:
         self.evictions_total = 0
         self.last_recovery_frames: Optional[int] = None
         self.last_stagger_jitter_ms: Optional[float] = None
+        # Slot SLO engine (obs/slo.py): per-tick samples reduce to
+        # burn-rate levels every slo_export_interval frames, exported
+        # through the labeled metrics path and fed to each slot's FSM.
+        self._per_group = per_group
+        self.slo = SlotSLO(config=slo_config, metrics=self.metrics)
+        self.slo_export_interval = max(1, int(slo_export_interval))
+        self.slo_levels: Dict[int, str] = {}
+        self.trace_dir = trace_dir
+
+    def _flat_slot(self, handle: MatchHandle) -> int:
+        """Server-wide slot id (group-qualified) — the SLO/metrics key.
+        Distinct from ``handle.slot``, which repeats across groups."""
+        return handle.group * self._per_group + handle.slot
 
     # -- gauges ---------------------------------------------------------
 
@@ -639,6 +657,21 @@ class MatchServer:
                         self._fault(handle, m, "session_error", cause=e)
                         continue
                     elapsed_ms = (self._clock() - t_m) * 1000.0
+                    # SLO sample: deadline hit + rollback depth (every
+                    # AdvanceFrame past the first in a canonical burst is
+                    # a resimulated frame).
+                    depth = max(
+                        0,
+                        sum(
+                            1 for r in requests
+                            if isinstance(r, AdvanceFrame)
+                        ) - 1,
+                    )
+                    self.slo.observe_tick(
+                        self._flat_slot(handle),
+                        deadline_ok=elapsed_ms <= self.watchdog_budget_ms,
+                        rollback_depth=depth,
+                    )
                     if elapsed_ms > self.watchdog_budget_ms:
                         if m.fsm.strike(frame):
                             # Deadline expiry: the requests are already in
@@ -665,6 +698,15 @@ class MatchServer:
                         )
         # Recovery lanes: off the hot path, after every group dispatched.
         now = self._clock()
+        # Group head frames — a lane's recovery debt is how far it trails
+        # the most-advanced batched slot of its group.
+        heads: Dict[int, int] = {}
+        for g, core in enumerate(self.groups):
+            frames = [
+                s.frame for s in core.slots if getattr(s, "active", False)
+            ]
+            if frames:
+                heads[g] = max(frames)
         for handle, lane in list(self._lanes.items()):
             m = self._matches.get(handle)
             if m is None:
@@ -675,6 +717,17 @@ class MatchServer:
                 lane.step(now)
             if m.fsm.state is SlotHealth.QUARANTINED and lane.advancing:
                 m.fsm.to(SlotHealth.RECOVERING)
+            debt = max(
+                0,
+                heads.get(handle.group, int(lane.runner.frame))
+                - int(lane.runner.frame),
+            )
+            self.slo.observe_tick(
+                self._flat_slot(handle),
+                deadline_ok=True,  # lanes are off the deadline path
+                recovery_debt=debt,
+                quarantined=m.fsm.state is SlotHealth.QUARANTINED,
+            )
             if lane.ready and m.fsm.state is SlotHealth.RECOVERING:
                 self._readmit(handle, lane)
             elif (
@@ -685,5 +738,60 @@ class MatchServer:
         self.last_stagger_jitter_ms = worst_jitter
         self.frames_served += 1
         self.metrics.count("frames_served")
+        if self.frames_served % self.slo_export_interval == 0:
+            self.slo_levels = self.slo.export()
+            for handle, m in self._matches.items():
+                lvl = self.slo_levels.get(self._flat_slot(handle))
+                if lvl is not None:
+                    m.fsm.slo_signal(lvl, frame=self.frames_served)
         if self.checkpointer is not None:
             self.checkpointer.maybe_save(self)
+
+    # -- telemetry export -----------------------------------------------
+
+    def export_telemetry(
+        self, directory: Optional[str] = None, prefix: str = "server"
+    ) -> Optional[Dict[str, str]]:
+        """Dump the server's telemetry set under ``directory`` (default:
+        the ``trace_dir`` it was built with): Perfetto trace (when the
+        tracer is enabled), Prometheus exposition, SLO snapshot JSON, and
+        the self-contained HTML ops report. Returns {artifact: path}, or
+        None when no directory is configured."""
+        import json as _json
+        import os as _os
+
+        from bevy_ggrs_tpu.obs.prom import export_prometheus
+        from bevy_ggrs_tpu.obs.report import build_report
+
+        directory = directory if directory is not None else self.trace_dir
+        if directory is None:
+            return None
+        _os.makedirs(directory, exist_ok=True)
+        out: Dict[str, str] = {}
+        if getattr(self.tracer, "enabled", False):
+            p = _os.path.join(directory, f"{prefix}_trace.json")
+            self.tracer.export_perfetto(p)
+            out["trace"] = p
+        p = _os.path.join(directory, f"{prefix}_metrics.prom")
+        export_prometheus(self.metrics, path=p)
+        out["metrics"] = p
+        p = _os.path.join(directory, f"{prefix}_slo.json")
+        with open(p, "w") as f:
+            _json.dump(self.slo.snapshot(), f, indent=2)
+        out["slo"] = p
+        p = _os.path.join(directory, f"{prefix}_report.html")
+        build_report(
+            p,
+            title=f"{prefix} ops report",
+            slo=self.slo,
+            tracers={prefix: self.tracer},
+            metrics=self.metrics,
+            notes=(
+                f"frames_served={self.frames_served} "
+                f"faults={self.faults_total} "
+                f"readmissions={self.readmissions_total} "
+                f"evictions={self.evictions_total}"
+            ),
+        )
+        out["report"] = p
+        return out

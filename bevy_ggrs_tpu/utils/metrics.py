@@ -46,23 +46,86 @@ class Timer:
         return False
 
 
+def escape_label_value(value: object) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline are the three characters the spec requires escaped
+    inside ``name{k="v"}`` — anything else passes through verbatim."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _labeled(name: str, labels: Optional[Dict[str, object]]) -> str:
     """Encode a labeled series/counter key in Prometheus exposition form:
-    ``name{k="v",...}`` with keys sorted, so the same label set always maps
-    to the same key and the prom exporter can re-emit it verbatim. Plain
-    (label-less) instruments keep their bare name — zero cost on the
-    existing hot paths."""
+    ``name{k="v",...}`` with keys sorted and values escaped per the text
+    format, so the same label set always maps to the same key and the prom
+    exporter can re-emit it verbatim. Plain (label-less) instruments keep
+    their bare name — zero cost on the existing hot paths."""
     if not labels:
         return name
-    body = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    body = ",".join(
+        f'{k}="{escape_label_value(labels[k])}"' for k in sorted(labels)
+    )
     return f"{name}{{{body}}}"
 
 
+# Distinct label sets admitted per metric family before new sets collapse
+# into the overflow bucket. 2048 clears `match_slot` at S=1024 with
+# headroom for a second dimension; a runaway producer (slot x reason x
+# peer, say) lands in ``name{overflow="true"}`` instead of growing the
+# exposition without bound.
+DEFAULT_LABEL_CARDINALITY = 2048
+_OVERFLOW_KEY = '{overflow="true"}'
+
+
 class Metrics:
-    def __init__(self) -> None:
+    def __init__(
+        self, label_cardinality: int = DEFAULT_LABEL_CARDINALITY
+    ) -> None:
         self.counters: Dict[str, float] = collections.defaultdict(float)
         self.series: Dict[str, List[float]] = collections.defaultdict(list)
         self._created = time.perf_counter()
+        self.label_cardinality = int(label_cardinality)
+        self._label_sets: Dict[str, set] = {}  # family -> admitted blocks
+        self.label_sets_dropped = 0
+        # (name, sorted label items) -> encoded key. Admitted sets only,
+        # so it is bounded by the cardinality cap per family; it spares
+        # the hot serve loop the escape/format work per labeled call
+        # (S=256 slots x several labeled counts per tick).
+        self._key_cache: Dict[tuple, str] = {}
+
+    def _key(self, name: str, labels: Optional[Dict[str, object]]) -> str:
+        """Storage key with the cardinality guard applied: once a family
+        holds `label_cardinality` distinct label sets, further NEW sets
+        map to the family's overflow bucket and bump `label_sets_dropped`
+        (also surfaced as a counter), keeping exposition size bounded no
+        matter what callers label with. Already-admitted sets keep
+        resolving to their own key."""
+        if not labels:
+            return name
+        try:
+            ck = (name, tuple(sorted(labels.items())))
+            cached = self._key_cache.get(ck)
+            if cached is not None:
+                return cached
+        except TypeError:  # unhashable label value — encode uncached
+            ck = None
+        key = _labeled(name, labels)
+        seen = self._label_sets.get(name)
+        if seen is None:
+            seen = self._label_sets[name] = set()
+        if key not in seen:
+            if len(seen) >= self.label_cardinality:
+                self.label_sets_dropped += 1
+                self.counters["label_sets_dropped"] += 1
+                return name + _OVERFLOW_KEY
+            seen.add(key)
+        if ck is not None:
+            self._key_cache[ck] = key
+        return key
 
     # -- instruments ----------------------------------------------------
 
@@ -70,13 +133,13 @@ class Metrics:
         self, name: str, n: float = 1,
         labels: Optional[Dict[str, object]] = None,
     ) -> None:
-        self.counters[_labeled(name, labels)] += n
+        self.counters[self._key(name, labels)] += n
 
     def observe(
         self, name: str, value: float,
         labels: Optional[Dict[str, object]] = None,
     ) -> None:
-        s = self.series[_labeled(name, labels)]
+        s = self.series[self._key(name, labels)]
         s.append(float(value))
         if len(s) > 100_000:  # bound memory on long sessions
             del s[: len(s) // 2]

@@ -2,7 +2,11 @@
 
 import numpy as np
 
-from bevy_ggrs_tpu.utils.metrics import Metrics, null_metrics
+from bevy_ggrs_tpu.utils.metrics import (
+    Metrics,
+    escape_label_value,
+    null_metrics,
+)
 
 
 class TestInstruments:
@@ -62,6 +66,84 @@ class TestInstruments:
         with null_metrics.timer("z"):
             pass
         assert null_metrics.summary() == {}
+
+    def test_null_metrics_accepts_labels(self):
+        null_metrics.count("x", labels={"match_slot": 3})
+        null_metrics.observe("y", 1.0, labels={"match_slot": 3})
+        assert null_metrics.summary() == {}
+
+
+class TestLabelEscaping:
+    def test_escapes_the_three_spec_characters(self):
+        assert escape_label_value('a"b') == 'a\\"b'
+        assert escape_label_value("a\\b") == "a\\\\b"
+        assert escape_label_value("a\nb") == "a\\nb"
+        assert escape_label_value("plain") == "plain"
+        assert escape_label_value(7) == "7"
+
+    def test_hostile_label_value_cannot_break_exposition(self):
+        # A label value carrying the exposition syntax itself must not
+        # terminate the block early or smuggle in a second label.
+        m = Metrics()
+        m.count("req", labels={"peer": 'evil"} injected{x="1'})
+        (key,) = list(m.counters)
+        # Every quote inside the value is escaped, so the block has
+        # exactly one un-escaped opening and closing quote — a text-format
+        # parser sees ONE label whose value is the hostile string.
+        unescaped = key.replace('\\"', "")
+        assert unescaped.count('"') == 2
+        assert key.startswith('req{peer="') and key.endswith('"}')
+
+    def test_label_keys_sorted_for_stable_identity(self):
+        m = Metrics()
+        m.count("req", labels={"b": 1, "a": 2})
+        m.count("req", labels={"a": 2, "b": 1})
+        assert list(m.counters) == ['req{a="2",b="1"}']
+        assert m.counters['req{a="2",b="1"}'] == 2
+
+
+class TestCardinalityGuard:
+    def test_overflow_bucket_after_cap(self):
+        m = Metrics(label_cardinality=4)
+        for s in range(10):
+            m.count("ticks", labels={"match_slot": s})
+        # First 4 sets admitted, the rest collapse into overflow.
+        assert m.label_sets_dropped == 6
+        assert m.counters['ticks{overflow="true"}'] == 6
+        assert m.counters["label_sets_dropped"] == 6
+        for s in range(4):
+            assert m.counters[f'ticks{{match_slot="{s}"}}'] == 1
+
+    def test_admitted_sets_keep_resolving_after_cap(self):
+        m = Metrics(label_cardinality=2)
+        m.count("ticks", labels={"match_slot": 0})
+        m.count("ticks", labels={"match_slot": 1})
+        m.count("ticks", labels={"match_slot": 2})  # dropped
+        m.count("ticks", labels={"match_slot": 0})  # still its own key
+        assert m.counters['ticks{match_slot="0"}'] == 2
+        assert m.label_sets_dropped == 1
+
+    def test_cap_is_per_family(self):
+        m = Metrics(label_cardinality=1)
+        m.count("a", labels={"k": 0})
+        m.count("b", labels={"k": 0})  # different family, own budget
+        assert m.label_sets_dropped == 0
+        m.observe("a", 1.0, labels={"k": 1})  # same family name, over cap
+        assert m.label_sets_dropped == 1
+
+    def test_unlabeled_instruments_bypass_the_guard(self):
+        m = Metrics(label_cardinality=0)
+        m.count("frames", 5)
+        m.observe("depth", 1.0)
+        assert m.counters["frames"] == 5
+        assert m.label_sets_dropped == 0
+
+    def test_default_cap_clears_match_slot_at_s1024(self):
+        m = Metrics()
+        for s in range(1024):
+            m.observe("slot_ms", 1.0, labels={"match_slot": s})
+        assert m.label_sets_dropped == 0
+        assert len(m.series) == 1024
 
 
 class TestIntegration:

@@ -122,6 +122,138 @@ class TestSpanTracer:
         assert null_tracer.export_jsonl(str(tmp_path / "x")) == 0
 
 
+class TestComponentTracks:
+    """Span-name prefixes land on distinct named tids per process, so a
+    merged fleet trace shows session / spec / server / relay rows instead
+    of one flat track."""
+
+    def test_prefixes_map_to_named_tracks(self):
+        t = SpanTracer(pid=7)
+        for name in ("net_poll", "spec_poll", "serve_tick", "relay_pump"):
+            with t.span(name):
+                pass
+        trace = t.export_perfetto()
+        assert_valid_trace(trace)
+        track_of = {}
+        names = {}
+        for e in trace["traceEvents"]:
+            if e["ph"] == "M" and e["name"] == "thread_name":
+                names[e["tid"]] = e["args"]["name"]
+            elif e["ph"] == "B":
+                track_of[e["name"]] = e["tid"]
+        # tid = tracer.tid * 4 + component offset (tracer.tid == 0 here).
+        assert track_of == {
+            "net_poll": 0, "spec_poll": 1, "serve_tick": 2, "relay_pump": 3,
+        }
+        assert names == {0: "session", 1: "spec", 2: "server", 3: "relay"}
+        # Process identity is uniform across tracks.
+        assert all(e["pid"] == 7 for e in trace["traceEvents"])
+
+    def test_srv_prefix_shares_the_server_track(self):
+        t = SpanTracer()
+        with t.span("srv_watchdog"):
+            pass
+        with t.span("serve_tick"):
+            pass
+        tids = {
+            e["name"]: e["tid"]
+            for e in t.export_perfetto()["traceEvents"]
+            if e["ph"] == "B"
+        }
+        assert tids["srv_watchdog"] == tids["serve_tick"] == 2
+
+    def test_component_tids_never_collide_across_tracers(self):
+        # tid stride is 4 == number of component offsets, so tracer tid 0
+        # owns 0..3 and tracer tid 1 owns 4..7.
+        a, b = SpanTracer(tid=0), SpanTracer(tid=1)
+        for t in (a, b):
+            with t.span("relay_pump"):  # highest offset (3)
+                pass
+            with t.span("net_poll"):    # lowest offset (0)
+                pass
+        tids_a = {e["tid"] for e in a.export_perfetto()["traceEvents"]
+                  if e["ph"] != "M"}
+        tids_b = {e["tid"] for e in b.export_perfetto()["traceEvents"]
+                  if e["ph"] != "M"}
+        assert tids_a == {0, 3} and tids_b == {4, 7}
+
+    def test_export_carries_wall_anchor_for_merge(self):
+        t = SpanTracer(pid=2, process_name="peer-2", wall_t0=1234.5)
+        with t.span("net_poll"):
+            pass
+        trace = t.export_perfetto()
+        assert trace["otherData"]["wall_t0"] == 1234.5
+        assert trace["otherData"]["pid"] == 2
+        assert trace["otherData"]["process_name"] == "peer-2"
+
+    def test_mixed_component_spans_stay_valid(self):
+        # Runtime order is globally LIFO; splitting by component track
+        # must preserve per-track B/E matching too.
+        t = SpanTracer()
+        for i in range(20):
+            with t.span("serve_tick", i=i):
+                with t.span("net_poll"):
+                    pass
+                with t.span("spec_poll"):
+                    pass
+        trace = t.export_perfetto()
+        assert_valid_trace(trace)
+        per_track = {}
+        for e in trace["traceEvents"]:
+            if e["ph"] in ("B", "E"):
+                per_track.setdefault(e["tid"], []).append(e)
+        for tid, evs in per_track.items():
+            stack = []
+            for e in evs:
+                if e["ph"] == "B":
+                    stack.append(e["name"])
+                else:
+                    assert stack and stack[-1] == e["name"]
+                    stack.pop()
+            assert stack == []
+
+
+class TestPromLabelExposition:
+    def test_labeled_counters_export_as_labeled_samples(self):
+        m = Metrics()
+        m.count("frames_advanced", 42, labels={"match_slot": 3})
+        m.observe("slot_ms", 1.5, labels={"match_slot": 3})
+        text = obs.export_prometheus(m)
+        assert 'ggrs_frames_advanced_total{match_slot="3"} 42' in text
+        assert 'ggrs_slot_ms{match_slot="3",quantile="0.5"} 1.5' in text
+        assert 'ggrs_slot_ms_count{match_slot="3"} 1' in text
+
+    def test_type_line_once_per_family_across_label_sets(self):
+        m = Metrics()
+        for s in range(3):
+            m.count("ticks", labels={"match_slot": s})
+        text = obs.export_prometheus(m)
+        assert text.count("# TYPE ggrs_ticks_total counter") == 1
+        assert text.count("ggrs_ticks_total{") == 3
+
+    def test_escaped_label_values_survive_exposition(self):
+        m = Metrics()
+        m.count("req", labels={"peer": 'p "quoted" \\ end'})
+        text = obs.export_prometheus(m)
+        line = next(
+            l for l in text.splitlines()
+            if l.startswith("ggrs_req_total{")
+        )
+        assert '\\"quoted\\"' in line and "\\\\" in line
+        # The label block still parses as exactly one k="v" pair.
+        assert line.count("{") == 1
+
+    def test_overflow_bucket_exports_and_is_bounded(self):
+        m = Metrics(label_cardinality=2)
+        for s in range(50):
+            m.count("ticks", labels={"match_slot": s})
+        text = obs.export_prometheus(m)
+        assert 'ggrs_ticks_total{overflow="true"} 48' in text
+        assert "ggrs_label_sets_dropped_total 48" in text
+        # Exposition stays bounded: 2 admitted + 1 overflow label set.
+        assert text.count("ggrs_ticks_total{") == 3
+
+
 class TestFlightRecorder:
     def test_health_transitions_and_counter_deltas(self):
         rec = obs.FlightRecorder(capacity=8)

@@ -36,7 +36,15 @@ from bevy_ggrs_tpu.chaos import (
     ServerKillRestart,
 )
 from bevy_ggrs_tpu.models import box_game
-from bevy_ggrs_tpu.obs import FlightRecorder
+from bevy_ggrs_tpu.obs import (
+    FlightRecorder,
+    ProvenanceLog,
+    SidecarSocket,
+    SpanTracer,
+    frame_flows,
+    merge_traces,
+)
+from bevy_ggrs_tpu.relay import RelayServer, RelaySocket, peer_addr
 from bevy_ggrs_tpu.runner import RollbackRunner
 from bevy_ggrs_tpu.serve import MatchServer, SlotHealth
 from bevy_ggrs_tpu.session import (
@@ -112,22 +120,24 @@ def server_inputs(frame, handle):
     return scripted_input(handle, frame)
 
 
-def build_server(ckpt_dir, capacity, groups, net, metrics):
+def build_server(ckpt_dir, capacity, groups, net, metrics, tracer=None):
     server = MatchServer(
         box_game.make_schedule(), box_game.make_world(2).commit(),
         MAX_PRED, 2, box_game.INPUT_SPEC,
         capacity=capacity, stagger_groups=groups,
         num_branches=BRANCHES, spec_frames=SPEC_FRAMES,
-        metrics=metrics, clock=lambda: net.now,
+        metrics=metrics, clock=lambda: net.now, tracer=tracer,
         checkpoint_dir=ckpt_dir, checkpoint_interval=120,
     )
     server.warmup()
     return server
 
 
-def make_host_session(net, m):
+def make_host_session(net, m, tap=None):
     """The server-side session of match ``m``: local player 0 at
-    ("srv", m), remote player 1 at ("ext", m)."""
+    ("srv", m), remote player 1 at ("ext", m). ``tap`` (optional) wraps
+    the raw socket in a passive provenance sidecar — all host sessions
+    share one "server" log, matching the server tracer's process row."""
     builder = (
         SessionBuilder(box_game.INPUT_SPEC)
         .with_num_players(2)
@@ -136,14 +146,17 @@ def make_host_session(net, m):
     )
     builder.add_player(PlayerType.local(), 0)
     builder.add_player(PlayerType.remote(("ext", m)), 1)
-    return builder.start_p2p_session(
-        net.socket(("srv", m)), clock=lambda: net.now
-    )
+    sock = net.socket(("srv", m))
+    if tap is not None:
+        sock = tap(sock, "server", 500)
+    return builder.start_p2p_session(sock, clock=lambda: net.now)
 
 
-def make_ext_peer(net, m, plan=None):
+def make_ext_peer(net, m, plan=None, tap=None):
     """The external peer of match ``m``: its own supervised singleton stack
-    (session + RollbackRunner + SessionSupervisor), chaos-wrapped."""
+    (session + RollbackRunner + SessionSupervisor), chaos-wrapped. The
+    provenance ``tap`` goes on the RAW socket, below the ChaosSocket, so
+    it records what actually crossed the wire (drops included)."""
     builder = (
         SessionBuilder(box_game.INPUT_SPEC)
         .with_num_players(2)
@@ -152,9 +165,10 @@ def make_ext_peer(net, m, plan=None):
     )
     builder.add_player(PlayerType.remote(("srv", m)), 0)
     builder.add_player(PlayerType.local(), 1)
-    session = builder.start_p2p_session(
-        net.socket(("ext", m)), clock=lambda: net.now
-    )
+    sock = net.socket(("ext", m))
+    if tap is not None:
+        sock = tap(sock, f"ext{m}", 600 + m)
+    session = builder.start_p2p_session(sock, clock=lambda: net.now)
     if plan is not None:
         session.socket = ChaosSocket(
             session.socket, plan, clock=lambda: net.now, addr=("ext", m)
@@ -213,10 +227,32 @@ def run_served_soak(
     faults, server metrics)."""
     net = LoopbackNetwork()
     metrics = Metrics()
-    server = build_server(ckpt_dir, capacity, groups, net, metrics)
-    ext = {m: make_ext_peer(net, m, plan) for m in range(n_matches)}
+    obs_dir = os.environ.get("GGRS_OBS_DIR")
+    # When GGRS_OBS_DIR is set the soak also captures the fleet-trace
+    # artifact set — a server SpanTracer plus passive provenance sidecars
+    # on every raw socket — without changing the soak's topology (the
+    # sidecars transmit nothing; see tests/test_telemetry_determinism.py).
+    # Logs live HERE (not in the peers) so kill/restart cycles append to
+    # one continuous per-component timeline.
+    tracer = (
+        SpanTracer(clock=lambda: net.now, pid=500, process_name="server")
+        if obs_dir else None
+    )
+    prov = {}
+
+    def tap(sock, component, pid):
+        log = prov.get(component)
+        if log is None:
+            log = prov[component] = ProvenanceLog(
+                component, pid=pid, clock=lambda: net.now
+            )
+        return SidecarSocket(sock, log)
+
+    tap = tap if obs_dir else None
+    server = build_server(ckpt_dir, capacity, groups, net, metrics, tracer)
+    ext = {m: make_ext_peer(net, m, plan, tap) for m in range(n_matches)}
     handle_of = {
-        m: server.add_match(make_host_session(net, m), server_inputs)
+        m: server.add_match(make_host_session(net, m, tap), server_inputs)
         for m in range(n_matches)
     }
     canon = {} if canon_match is not None else None
@@ -230,7 +266,6 @@ def run_served_soak(
          "killed": False, "done": False}
         for k in plan.server_kill_restarts()
     ]
-    obs_dir = os.environ.get("GGRS_OBS_DIR")
     recorders = (
         {"server": FlightRecorder(),
          **{m: FlightRecorder() for m in ext}}
@@ -248,7 +283,7 @@ def run_served_soak(
                 k["killed"] = True
             elif k["killed"] and not k["done"] and net.now >= k["until"]:
                 m = k["me"]
-                fresh = make_ext_peer(net, m, plan)
+                fresh = make_ext_peer(net, m, plan, tap)
                 fresh[2].begin_rejoin(("srv", m))
                 ext[m] = fresh
                 k["done"] = True
@@ -261,10 +296,10 @@ def run_served_soak(
                 k["killed"] = True
             elif k["killed"] and not k["done"] and net.now >= k["until"]:
                 server = build_server(ckpt_dir, capacity, groups, net,
-                                      metrics)
+                                      metrics, tracer)
                 attachments = {
                     (h.group, h.slot): {
-                        "session": make_host_session(net, m),
+                        "session": make_host_session(net, m, tap),
                         "local_inputs": server_inputs,
                         "donor": ("ext", m),
                     }
@@ -297,6 +332,20 @@ def run_served_soak(
             rec.export_jsonl(
                 os.path.join(obs_dir, f"serve_soak_{name}_frames.jsonl")
             )
+        prov_paths = []
+        for comp, log in prov.items():
+            p = os.path.join(obs_dir, f"serve_soak_{comp}_provenance.jsonl")
+            log.export_jsonl(p)
+            prov_paths.append(p)
+        trace_paths = []
+        if server is not None:
+            arts = server.export_telemetry(obs_dir, prefix="serve_soak")
+            if arts and "trace" in arts:
+                trace_paths.append(arts["trace"])
+        merge_traces(
+            trace_paths, prov_paths,
+            path=os.path.join(obs_dir, "serve_soak_merged_trace.json"),
+        )
     assert all(k["done"] for k in kills + skrs)
     return server, ext, handle_of, restore_frame, canon, faults, metrics
 
@@ -345,6 +394,186 @@ def test_server_crash_restart_smoke(tmp_path):
     assert server.evictions_total == 0
     assert server.cache_size() == 1
     assert any(k == "loss" for _, k, _ in faults)
+
+
+def test_soak_exports_fleet_trace_artifacts(tmp_path, monkeypatch):
+    """GGRS_OBS_DIR turns the soak into an artifact producer: flight
+    recorder frames, per-component provenance logs, the server telemetry
+    set (trace/metrics/SLO/HTML report), and one merged Perfetto trace —
+    continuous across the server kill/restart."""
+    import json
+
+    obs = tmp_path / "obs"
+    monkeypatch.setenv("GGRS_OBS_DIR", str(obs))
+    run_served_soak(
+        SMOKE_PLAN, n_matches=2, n_iters=330, capacity=2, groups=1,
+        ckpt_dir=str(tmp_path / "ckpt"),
+    )
+    for f in (
+        "serve_soak_server_frames.jsonl",
+        "serve_soak_server_provenance.jsonl",
+        "serve_soak_ext0_provenance.jsonl",
+        "serve_soak_ext1_provenance.jsonl",
+        "serve_soak_trace.json",
+        "serve_soak_metrics.prom",
+        "serve_soak_slo.json",
+        "serve_soak_report.html",
+        "serve_soak_merged_trace.json",
+    ):
+        p = obs / f
+        assert p.exists() and p.stat().st_size > 0, f"missing artifact {f}"
+    with open(obs / "serve_soak_merged_trace.json") as f:
+        merged = json.load(f)
+    events = merged["traceEvents"]
+    # Server span track AND all three wire tracks landed in one trace,
+    # with cross-process flow arrows stitched between them.
+    tracks = {
+        ev["args"]["name"]
+        for ev in events
+        if ev.get("ph") == "M" and ev["name"] == "thread_name"
+    }
+    assert {"wire:server", "wire:ext0", "wire:ext1"} <= tracks
+    assert "server" in tracks  # the tracer's serve-loop track
+    flow_pids = {}
+    for ev in events:
+        if ev.get("cat") == "flow":
+            flow_pids.setdefault(ev["id"], set()).add(ev["pid"])
+    assert any(len(p) >= 2 for p in flow_pids.values())
+    # The provenance timeline is continuous across the server restart:
+    # records exist both before the kill (t=3.0) and after (t=4.5).
+    kill_us, back_us = int(3.0e6), int(4.5e6)
+    stamps = []
+    with open(obs / "serve_soak_server_provenance.jsonl") as f:
+        for line in f:
+            rec = json.loads(line)
+            if "meta" not in rec:
+                stamps.append(rec["ts_us"])
+    assert min(stamps) < kill_us and max(stamps) > back_us
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: one frame's provenance spans peer / relay / server tracks
+# ---------------------------------------------------------------------------
+
+
+def test_served_relay_trace_spans_three_component_tracks(tmp_path):
+    """A match whose server-hosted replica talks to its external peer
+    THROUGH the relay tier, with passive sidecars on all three raw
+    sockets: the merged trace carries wire tracks for peer, relay and
+    server, and one input frame's flow chain crosses all three —
+    tx at the originator, rx+tx at the relay, rx at the terminal."""
+    net = LoopbackNetwork()
+    logs = {}
+
+    def tap(sock, component, pid):
+        log = logs[component] = ProvenanceLog(
+            component, pid=pid, clock=lambda: net.now
+        )
+        return SidecarSocket(sock, log)
+
+    relay_tracer = SpanTracer(
+        clock=lambda: net.now, pid=100, process_name="relay"
+    )
+    relay = RelayServer(
+        tap(net.socket(("relay", 0)), "relay", 100),
+        clock=lambda: net.now, tracer=relay_tracer,
+    )
+
+    def relay_session(me, component, pid):
+        rsock = RelaySocket(
+            tap(net.socket(("peer", me)), component, pid),
+            [("relay", 0)], session_id=1, peer_id=me,
+            clock=lambda: net.now,
+        )
+        builder = (
+            SessionBuilder(box_game.INPUT_SPEC)
+            .with_num_players(2)
+            .with_max_prediction_window(MAX_PRED)
+            .with_disconnect_timeout(1.0)
+        )
+        for h in range(2):
+            builder.add_player(
+                PlayerType.local() if h == me
+                else PlayerType.remote(peer_addr(h)), h,
+            )
+        return builder.start_p2p_session(rsock, clock=lambda: net.now)
+
+    tracer = SpanTracer(clock=lambda: net.now, pid=500,
+                        process_name="server")
+    server = build_server(
+        str(tmp_path / "ckpt"), 1, 1, net, Metrics(), tracer
+    )
+    server.add_match(relay_session(0, "server", 500), server_inputs)
+    ext_sess = relay_session(1, "ext", 600)
+    ext_runner = RollbackRunner(
+        box_game.make_schedule(), box_game.make_world(2).commit(),
+        max_prediction=MAX_PRED, num_players=2,
+        input_spec=box_game.INPUT_SPEC,
+    )
+    for _ in range(300):
+        net.advance(FPS_DT)
+        relay.pump(net.now)
+        server.run_frame()
+        ext_sess.poll_remote_clients()
+        if ext_sess.current_state() != SessionState.RUNNING:
+            continue
+        for h in ext_sess.local_player_handles():
+            ext_sess.add_local_input(
+                h, scripted_input(h, ext_sess.current_frame)
+            )
+        try:
+            ext_runner.handle_requests(ext_sess.advance_frame(), ext_sess)
+        except PredictionThreshold:
+            pass
+    assert ext_sess.current_frame >= 150  # the match actually ran
+
+    obs = tmp_path / "obs"
+    os.makedirs(obs)
+    prov_paths = []
+    for comp, log in logs.items():
+        p = str(obs / f"{comp}_provenance.jsonl")
+        log.export_jsonl(p)
+        prov_paths.append(p)
+    relay_trace = str(obs / "relay_trace.json")
+    relay_tracer.export_perfetto(relay_trace)
+    arts = server.export_telemetry(str(obs), prefix="served_relay")
+    merged = merge_traces(
+        [arts["trace"], relay_trace], prov_paths,
+        path=str(obs / "merged_trace.json"),
+    )
+
+    # Three component wire tracks plus both span tracers, one timeline.
+    tracks = {
+        ev["args"]["name"]
+        for ev in merged["traceEvents"]
+        if ev.get("ph") == "M" and ev["name"] == "thread_name"
+    }
+    assert {"wire:server", "wire:relay", "wire:ext"} <= tracks
+    # Flow arrows cross at least three distinct merged processes.
+    flow_pids = {}
+    for ev in merged["traceEvents"]:
+        if ev.get("cat") == "flow":
+            flow_pids.setdefault(ev["id"], set()).add(ev["pid"])
+    assert any(len(p) >= 3 for p in flow_pids.values())
+
+    # One frame's provenance, followed end to end: originator tx ->
+    # relay rx -> relay tx -> terminal rx, identical flow key throughout.
+    spanning = None
+    for frame in range(40, 90):
+        for chain in frame_flows(prov_paths, frame).values():
+            if {"server", "relay", "ext"} <= {c for c, _ in chain}:
+                spanning = chain
+                break
+        if spanning:
+            break
+    assert spanning is not None, "no input frame crossed all three tracks"
+    comps = [c for c, _ in spanning]
+    dirs = [r["dir"] for _, r in spanning]
+    assert comps[0] in ("server", "ext") and dirs[0] == "tx"
+    assert comps[-1] in ("server", "ext") and dirs[-1] == "rx"
+    i = comps.index("relay")
+    assert comps[i:i + 2] == ["relay", "relay"]
+    assert dirs[i:i + 2] == ["rx", "tx"]  # the relay forwarded verbatim
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,249 @@
+"""Slot SLO engine: burn-rate math over short/long windows, the
+multi-window alert policy (fast burn on BOTH windows pages, slow burn on
+the long window warns), labeled Prometheus export through the
+cardinality-guarded metrics path, and the SlotHealthFSM coupling
+(``slo_signal``)."""
+
+import pytest
+
+from bevy_ggrs_tpu.obs import export_prometheus
+from bevy_ggrs_tpu.obs.slo import (
+    LEVEL_OK,
+    LEVEL_PAGE,
+    LEVEL_WARN,
+    SLOConfig,
+    SlotSLO,
+)
+from bevy_ggrs_tpu.serve.faults import SlotHealth, SlotHealthFSM
+from bevy_ggrs_tpu.utils.metrics import Metrics
+
+
+def feed(slo, slot, n, **kw):
+    kw.setdefault("deadline_ok", True)
+    for _ in range(n):
+        slo.observe_tick(slot, **kw)
+
+
+CFG = SLOConfig(short_window=8, long_window=32, min_samples=4)
+
+
+class TestBurnRates:
+    def test_burn_is_bad_fraction_over_budget(self):
+        slo = SlotSLO(SLOConfig(deadline_objective=0.9, short_window=8,
+                                long_window=32))
+        # 16 ticks, 4 misses -> bad fraction 0.25, budget 0.1, burn 2.5.
+        for i in range(16):
+            slo.observe_tick(0, deadline_ok=(i % 4 != 0))
+        stats = slo.burn_rates(0)["deadline"]
+        assert stats["long_n"] == 16
+        assert stats["long_bad"] == pytest.approx(0.25)
+        assert stats["long_burn"] == pytest.approx(2.5)
+        # Short window sees only the newest 8 ticks (2 misses there).
+        assert stats["short_n"] == 8
+        assert stats["short_burn"] == pytest.approx(2.5)
+
+    def test_windows_are_bounded_rings(self):
+        slo = SlotSLO(CFG)
+        feed(slo, 0, 100, deadline_ok=False)
+        feed(slo, 0, 32, deadline_ok=True)
+        stats = slo.burn_rates(0)["deadline"]
+        # The long ring holds only the newest 32 ticks — all good now.
+        assert stats["long_n"] == 32 and stats["long_bad"] == 0.0
+
+    def test_all_four_objectives_sampled_per_tick(self):
+        slo = SlotSLO(CFG)
+        slo.observe_tick(
+            0, deadline_ok=False, rollback_depth=99,
+            recovery_debt=99, quarantined=True,
+        )
+        rates = slo.burn_rates(0)
+        assert set(rates) == {
+            "deadline", "rollback", "recovery", "quarantine",
+        }
+        assert all(r["long_bad"] == 1.0 for r in rates.values())
+
+    def test_limits_decide_badness(self):
+        cfg = SLOConfig(rollback_depth_limit=6, recovery_debt_limit=30)
+        slo = SlotSLO(cfg)
+        slo.observe_tick(0, deadline_ok=True, rollback_depth=6,
+                         recovery_debt=30)
+        slo.observe_tick(0, deadline_ok=True, rollback_depth=7,
+                         recovery_debt=31)
+        rates = slo.burn_rates(0)
+        assert rates["rollback"]["long_bad"] == pytest.approx(0.5)
+        assert rates["recovery"]["long_bad"] == pytest.approx(0.5)
+
+    def test_unknown_slot_is_empty(self):
+        assert SlotSLO(CFG).burn_rates(42) == {}
+
+
+class TestAlertLevels:
+    def test_page_needs_fast_burn_on_both_windows(self):
+        slo = SlotSLO(CFG)
+        # Sustained total failure: both windows burn at 1/0.01 = 100.
+        feed(slo, 0, 32, deadline_ok=False)
+        assert slo.level(0) == LEVEL_PAGE
+
+    def test_one_bad_tick_never_pages(self):
+        slo = SlotSLO(CFG)
+        feed(slo, 0, 31, deadline_ok=True)
+        slo.observe_tick(0, deadline_ok=False)
+        # Long window burn: (1/32)/0.01 ≈ 3.1 < fast_burn AND < slow_burn.
+        assert slo.level(0) == LEVEL_OK
+
+    def test_recovered_slot_stops_paging_but_warns_on_long_window(self):
+        slo = SlotSLO(CFG)
+        feed(slo, 0, 16, deadline_ok=False)  # the incident
+        feed(slo, 0, 8, deadline_ok=True)    # short window now clean
+        stats = slo.burn_rates(0)["deadline"]
+        assert stats["short_burn"] < CFG.fast_burn
+        assert stats["long_burn"] >= CFG.slow_burn
+        assert slo.level(0) == LEVEL_WARN
+
+    def test_min_samples_suppresses_early_alerts(self):
+        slo = SlotSLO(SLOConfig(short_window=8, long_window=32,
+                                min_samples=16))
+        feed(slo, 0, 8, deadline_ok=False)  # total failure, tiny sample
+        assert slo.level(0) == LEVEL_OK
+
+    def test_levels_are_per_slot(self):
+        slo = SlotSLO(CFG)
+        feed(slo, 0, 32, deadline_ok=False)
+        feed(slo, 1, 32, deadline_ok=True)
+        assert slo.level(0) == LEVEL_PAGE
+        assert slo.level(1) == LEVEL_OK
+
+
+class TestExport:
+    def test_labeled_burn_series_and_transition_counters(self):
+        m = Metrics()
+        slo = SlotSLO(CFG, metrics=m)
+        feed(slo, 3, 32, deadline_ok=False)
+        feed(slo, 5, 32, deadline_ok=True)
+        levels = slo.export()
+        assert levels == {3: LEVEL_PAGE, 5: LEVEL_OK}
+        text = export_prometheus(m)
+        assert ('ggrs_slo_burn_short{match_slot="3",objective="deadline"'
+                ',quantile="0.5"}') in text
+        assert ('ggrs_slo_level_transitions_total'
+                '{match_slot="3",to="page"} 1') in text
+        # Transition counters fire on CHANGE, not on every export.
+        slo.export()
+        assert m.counters[
+            'slo_level_transitions{match_slot="3",to="page"}'
+        ] == 1
+
+    def test_export_is_cardinality_bounded(self):
+        m = Metrics(label_cardinality=8)
+        slo = SlotSLO(CFG, metrics=m)
+        for s in range(64):
+            feed(slo, s, 8, deadline_ok=True)
+        slo.export()
+        # 64 slots x 4 objectives would be 256 label sets; the guard
+        # keeps the family at its cap plus one overflow bucket.
+        burn_sets = [k for k in m.series if k.startswith("slo_burn_short")]
+        assert len(burn_sets) == 8 + 1
+        assert m.label_sets_dropped > 0
+
+    def test_snapshot_shape_for_the_ops_report(self):
+        slo = SlotSLO(CFG)
+        feed(slo, 0, 32, deadline_ok=False)
+        snap = slo.snapshot()
+        assert snap["config"]["short_window"] == 8
+        assert snap["slots"]["0"]["level"] == LEVEL_PAGE
+        assert "deadline" in snap["slots"]["0"]["objectives"]
+
+
+class TestFSMCoupling:
+    def test_page_degrades_a_healthy_slot(self):
+        fsm = SlotHealthFSM(0)
+        fsm.slo_signal(LEVEL_PAGE, frame=100)
+        assert fsm.state is SlotHealth.DEGRADED
+        assert fsm.strikes == 0
+
+    def test_ok_recovers_an_slo_degraded_slot(self):
+        fsm = SlotHealthFSM(0)
+        fsm.slo_signal(LEVEL_PAGE)
+        fsm.slo_signal(LEVEL_OK)
+        assert fsm.state is SlotHealth.HEALTHY
+
+    def test_ok_must_not_mask_live_watchdog_strikes(self):
+        fsm = SlotHealthFSM(0)
+        fsm.strike(frame=10)  # watchdog owns this DEGRADED
+        assert fsm.state is SlotHealth.DEGRADED
+        fsm.slo_signal(LEVEL_OK)
+        assert fsm.state is SlotHealth.DEGRADED
+        fsm.clear()  # the streak ends -> HEALTHY again
+        assert fsm.state is SlotHealth.HEALTHY
+
+    def test_warn_is_observability_only(self):
+        fsm = SlotHealthFSM(0)
+        fsm.slo_signal(LEVEL_WARN)
+        assert fsm.state is SlotHealth.HEALTHY
+
+    def test_page_does_not_touch_quarantined_slots(self):
+        fsm = SlotHealthFSM(0)
+        fsm.to(SlotHealth.QUARANTINED, reason="fault")
+        fsm.slo_signal(LEVEL_PAGE)
+        assert fsm.state is SlotHealth.QUARANTINED
+
+
+class TestServerIntegration:
+    def test_match_server_exports_slo_levels_and_signals_fsm(self):
+        """A MatchServer run at a small export interval populates per-slot
+        SLO windows from its own tick loop, pushes levels into each slot's
+        FSM, and exports labeled burn series."""
+        from tests.test_serve_faults import (
+            inputs_for,
+            make_server,
+            make_synctest,
+        )
+
+        metrics = Metrics()
+        server = make_server(metrics=metrics, slo_export_interval=4)
+        handles = [
+            server.add_match(make_synctest(), inputs_for(s))
+            for s in range(2)
+        ]
+        for _ in range(24):
+            server.run_frame()
+        assert server.slo_levels  # export ran at the interval
+        for h in handles:
+            f = server._flat_slot(h)
+            assert f in server.slo_levels
+            assert server.slo.burn_rates(f)["deadline"]["long_n"] > 0
+        # A healthy run never pages, and every FSM stays HEALTHY.
+        assert all(l == LEVEL_OK for l in server.slo_levels.values())
+        assert all(
+            m.fsm.state is SlotHealth.HEALTHY
+            for m in server._matches.values()
+        )
+        text = export_prometheus(metrics)
+        assert "ggrs_slo_burn_short{" in text
+
+    def test_rollback_burn_degrades_slot_without_any_watchdog_strike(self):
+        """The SLO catches what the watchdog can't: every tick lands
+        inside its budget (zero strikes), but a pathological rollback
+        objective (limit 0 against synctest sessions, which roll back
+        every frame) burns the budget — the exported page level drives
+        the slot FSM to DEGRADED through ``slo_signal`` alone."""
+        from tests.test_serve_faults import (
+            inputs_for,
+            make_server,
+            make_synctest,
+        )
+
+        server = make_server(
+            slo_config=SLOConfig(short_window=8, long_window=32,
+                                 min_samples=4, rollback_depth_limit=0),
+            slo_export_interval=4,
+        )
+        h = server.add_match(make_synctest(), inputs_for(0))
+        for _ in range(40):
+            server.run_frame()
+        flat = server._flat_slot(h)
+        assert server.slo.burn_rates(flat)["rollback"]["long_bad"] > 0.5
+        assert server.slo_levels[flat] == LEVEL_PAGE
+        m = server._matches[h]
+        assert m.fsm.state is SlotHealth.DEGRADED
+        assert m.fsm.strikes == 0  # the watchdog never fired

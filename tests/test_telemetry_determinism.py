@@ -1,0 +1,199 @@
+"""Telemetry is provably inert: the full observability stack (span
+tracer, metrics, flight recorder, provenance sidecar) switched ON
+produces bitwise-identical simulation results AND bitwise-identical
+wire traffic versus the same run with everything OFF.
+
+The wire-level check uses a test-local recorder at the very bottom of
+the socket stack — present in BOTH runs, so the only variable is the
+telemetry above it. Chaos faults ride a seeded plan whose RNG draws per
+send must stay aligned; a sidecar that transmitted anything (or drew
+randomness) would shift the fault schedule and fail the byte compare.
+"""
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu.chaos import ChaosPlan, ChaosSocket
+from bevy_ggrs_tpu.models import box_game
+from bevy_ggrs_tpu.obs import (
+    FlightRecorder,
+    ProvenanceLog,
+    SidecarSocket,
+    SpanTracer,
+)
+from bevy_ggrs_tpu.runner import RollbackRunner
+from bevy_ggrs_tpu.session import (
+    PlayerType,
+    PredictionThreshold,
+    SessionBuilder,
+    SessionState,
+)
+from bevy_ggrs_tpu.state import checksum, combine64
+from bevy_ggrs_tpu.transport.loopback import LoopbackNetwork
+from bevy_ggrs_tpu.utils.metrics import Metrics
+from tests.test_batched_sessions import drive, make_core, make_script
+from tests.test_p2p import FPS_DT, scripted_input
+
+
+class WireRecorder:
+    """Bottom-of-stack byte witness, identical in both runs."""
+
+    def __init__(self, inner, log):
+        self.inner = inner
+        self.log = log
+
+    def send_to(self, data, addr):
+        self.log.append(("tx", bytes(data), addr))
+        self.inner.send_to(data, addr)
+
+    def receive_all(self):
+        out = self.inner.receive_all()
+        for addr, data in out:
+            self.log.append(("rx", bytes(data), addr))
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def run_p2p(telemetry: bool):
+    net = LoopbackNetwork()
+    plan = ChaosPlan.generate(11, 3.0, (("peer", 0), ("peer", 1)))
+    wires = {0: [], 1: []}
+    history = [{}, {}]
+    recorder = FlightRecorder() if telemetry else None
+    peers = []
+    for me in range(2):
+        sock = WireRecorder(net.socket(("peer", me)), wires[me])
+        if telemetry:
+            sock = SidecarSocket(
+                sock,
+                ProvenanceLog(f"peer{me}", pid=me, clock=lambda: net.now),
+            )
+        sock = ChaosSocket(
+            sock, plan, clock=lambda: net.now, addr=("peer", me)
+        )
+        builder = (
+            SessionBuilder(box_game.INPUT_SPEC)
+            .with_num_players(2)
+            .with_max_prediction_window(8)
+        )
+        for h in range(2):
+            builder.add_player(
+                PlayerType.local() if h == me
+                else PlayerType.remote(("peer", h)), h,
+            )
+        kw = {}
+        if telemetry:
+            kw = dict(
+                metrics=Metrics(),
+                tracer=SpanTracer(clock=lambda: net.now, pid=me),
+            )
+        session = builder.start_p2p_session(
+            sock, clock=lambda: net.now, **kw
+        )
+        runner = RollbackRunner(
+            box_game.make_schedule(), box_game.make_world(2).commit(),
+            max_prediction=8, num_players=2,
+            input_spec=box_game.INPUT_SPEC, **kw,
+        )
+        peers.append((session, runner))
+    for _ in range(240):
+        net.advance(FPS_DT)
+        for i, (session, runner) in enumerate(peers):
+            session.poll_remote_clients()
+            if session.current_state() != SessionState.RUNNING:
+                continue
+            for h in session.local_player_handles():
+                session.add_local_input(
+                    h, scripted_input(h, session.current_frame)
+                )
+            try:
+                runner.handle_requests(session.advance_frame(), session)
+            except PredictionThreshold:
+                continue
+            history[i].update(session._local_checksums)
+            if telemetry and i == 0:
+                recorder.capture(session=session, runner=runner)
+    assert all(s.current_frame >= 150 for s, _ in peers)
+    final = [combine64(checksum(r.state)) for _, r in peers]
+    return wires, history, final
+
+
+class TestP2PInert:
+    def test_full_stack_on_vs_off_is_bitwise_identical(self):
+        on = run_p2p(telemetry=True)
+        off = run_p2p(telemetry=False)
+        # Same wire bytes, same order, both directions, both peers —
+        # the sidecar transmitted nothing and moved no chaos RNG draw.
+        assert on[0] == off[0]
+        # Same per-frame state checksums and same final states.
+        assert on[1] == off[1]
+        assert on[2] == off[2]
+
+
+def run_batched(telemetry: bool, S=8):
+    kw = {}
+    if telemetry:
+        kw = dict(metrics=Metrics(), tracer=SpanTracer())
+    core = make_core(num_slots=S, **kw)
+    slots = [core.admit() for _ in range(S)]
+    scripts = {
+        s: make_script(seed=200 + s, depth=1 + (s % 4), cycles=2)
+        for s in slots
+    }
+    drive(core, scripts)
+    sums = {s: combine64(checksum(core.slot_state(s))) for s in slots}
+    logs = {s: dict(core.slots[s].input_log) for s in slots}
+    return sums, logs
+
+
+class TestBatchedInert:
+    def test_s8_checksums_and_input_logs_identical(self):
+        on_sums, on_logs = run_batched(telemetry=True)
+        off_sums, off_logs = run_batched(telemetry=False)
+        assert on_sums == off_sums
+        assert on_logs.keys() == off_logs.keys()
+        for s in on_logs:
+            assert on_logs[s].keys() == off_logs[s].keys()
+            for f in on_logs[s]:
+                assert np.array_equal(on_logs[s][f], off_logs[s][f]), (
+                    f"slot {s} frame {f} canonical input log diverged"
+                )
+
+
+@pytest.mark.slow
+class TestEnabledOverhead:
+    def test_enabled_path_overhead_within_5pct_of_frame_budget_s256(self):
+        """Acceptance: the ENABLED telemetry path (spans + labeled
+        metrics) adds at most 5% of the 60 Hz frame budget per batched
+        tick at S=256."""
+        import time
+
+        S, frame_ms = 256, 1000.0 / 60.0
+
+        def timed(telemetry):
+            kw = {}
+            if telemetry:
+                kw = dict(metrics=Metrics(), tracer=SpanTracer())
+            core = make_core(num_slots=S, **kw)
+            slots = [core.admit() for _ in range(S)]
+            scripts = {
+                s: make_script(seed=300 + s, depth=1 + (s % 4), cycles=3)
+                for s in slots
+            }
+            ticks = max(len(v) for v in scripts.values())
+            t0 = time.perf_counter()
+            drive(core, scripts)
+            return (time.perf_counter() - t0) * 1000.0 / ticks
+
+        base = timed(False)
+        # Warm both paths' executables before trusting the clock.
+        timed(True)
+        enabled = timed(True)
+        overhead = enabled - base
+        assert overhead <= 0.05 * frame_ms, (
+            f"enabled telemetry adds {overhead:.3f} ms/tick at S={S} "
+            f"(budget 5% of {frame_ms:.1f} ms = {0.05 * frame_ms:.3f} ms; "
+            f"base {base:.3f} ms, enabled {enabled:.3f} ms)"
+        )

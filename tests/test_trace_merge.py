@@ -1,0 +1,315 @@
+"""Cross-process frame provenance + trace merge.
+
+The tentpole contract: a passive :class:`SidecarSocket` tap on each
+process's raw socket records every datagram with an FNV-1a flow key;
+because the relay forwards envelope bytes verbatim, the same key appears
+at peer-tx, relay-rx, relay-tx, and destination-rx — so
+:func:`merge_traces` can stitch per-process exports into ONE Perfetto
+timeline where a single input's journey spans the peer, relay, and
+destination tracks as flow arrows, with zero telemetry bytes on the
+wire."""
+
+import json
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu.models import box_game
+from bevy_ggrs_tpu.obs import (
+    ProvenanceLog,
+    SidecarSocket,
+    SpanTracer,
+    flow_key,
+    follow,
+    frame_flows,
+    merge_traces,
+)
+from bevy_ggrs_tpu.obs.merge import WIRE_TID, main as merge_main
+from bevy_ggrs_tpu.obs.provenance import _classify
+from bevy_ggrs_tpu.relay import RelayServer, RelaySocket, peer_addr
+from bevy_ggrs_tpu.runner import RollbackRunner
+from bevy_ggrs_tpu.session import (
+    PlayerType,
+    PredictionThreshold,
+    SessionBuilder,
+    SessionState,
+    protocol,
+)
+from bevy_ggrs_tpu.transport.loopback import LoopbackNetwork
+from tests.test_p2p import FPS_DT, scripted_input
+
+
+def input_datagram(start_frame=7, handle=0):
+    return protocol.encode(
+        protocol.InputMsg(
+            handle=handle, start_frame=start_frame, payload=b"\x01",
+            num=1, ack_frame=-1, sender_frame=9, advantage=0,
+        )
+    )
+
+
+class TestFlowKey:
+    def test_deterministic_and_content_sensitive(self):
+        a = input_datagram(7)
+        assert flow_key(a) == flow_key(bytes(a))
+        assert flow_key(a) != flow_key(input_datagram(8))
+        assert 0 <= flow_key(b"") < 2 ** 64
+
+    def test_relay_envelope_digest_differs_from_inner(self):
+        inner = input_datagram(7)
+        fwd = protocol.encode(protocol.RelayForward(0, 1, inner))
+        assert flow_key(fwd) != flow_key(inner)
+
+
+class TestClassify:
+    def test_input_carries_its_start_frame(self):
+        tag, frame, inner = _classify(input_datagram(start_frame=42))
+        assert (tag, frame, inner) == ("input", 42, None)
+
+    def test_relay_forward_classifies_the_inner_datagram(self):
+        fwd = protocol.encode(
+            protocol.RelayForward(0, 1, input_datagram(start_frame=5))
+        )
+        tag, frame, inner = _classify(fwd)
+        assert tag == "relay_forward"
+        assert inner == "input" and frame == 5
+
+    def test_stream_and_checksum_frames(self):
+        cs = protocol.encode(protocol.ChecksumReport(frame=11, checksum=3))
+        assert _classify(cs)[:2] == ("checksum_report", 11)
+
+    def test_garbage_is_tagged_not_raised(self):
+        assert _classify(b"")[0] == "garbage"
+        assert _classify(b"\x00" * 16)[0] == "garbage"
+        # Truncated body after a valid header: tag survives, frame is None.
+        hdr = protocol._HDR.pack(protocol.MAGIC, protocol.VERSION,
+                                 protocol.T_INPUT)
+        assert _classify(hdr)[:2] == ("input", None)
+
+
+class TestSidecarSocket:
+    def test_records_tx_rx_and_forwards_verbatim(self):
+        net = LoopbackNetwork()
+        log_a = ProvenanceLog("a", pid=0, clock=lambda: net.now)
+        log_b = ProvenanceLog("b", pid=1, clock=lambda: net.now)
+        sa = SidecarSocket(net.socket(("peer", 0)), log_a)
+        sb = SidecarSocket(net.socket(("peer", 1)), log_b)
+        msg = input_datagram(3)
+        sa.send_to(msg, ("peer", 1))
+        net.advance(FPS_DT)
+        got = sb.receive_all()
+        assert got == [(("peer", 0), msg)]  # verbatim pass-through
+        (tx,), (rx,) = log_a.records(), log_b.records()
+        assert tx["dir"] == "tx" and rx["dir"] == "rx"
+        assert tx["key"] == rx["key"] == flow_key(msg)
+        assert tx["frame"] == rx["frame"] == 3
+        assert tx["type"] == "input"
+
+    def test_context_rides_records_not_payloads(self):
+        net = LoopbackNetwork()
+        log = ProvenanceLog("a", clock=lambda: net.now)
+        s = SidecarSocket(net.socket(("peer", 0)), log)
+        msg = input_datagram(1)
+        log.set_context(match=17, epoch=2)
+        s.send_to(msg, ("peer", 1))
+        log.set_context(match=None)
+        s.send_to(msg, ("peer", 1))
+        first, second = log.records()
+        assert first["match"] == 17 and first["epoch"] == 2
+        assert "match" not in second and second["epoch"] == 2
+        # Same payload, same key: context never touched the bytes.
+        assert first["key"] == second["key"]
+
+    def test_capacity_bounds_the_ring(self):
+        log = ProvenanceLog("a", capacity=4)
+        for i in range(10):
+            log.record("tx", input_datagram(i), ("x", 0))
+        recs = log.records()
+        assert len(recs) == 4 and recs[-1]["frame"] == 9
+
+    def test_delegates_beyond_protocol_surface(self):
+        net = LoopbackNetwork()
+        s = SidecarSocket(net.socket(("peer", 5)), ProvenanceLog("a"))
+        assert s.addr == ("peer", 5)
+
+    def test_jsonl_round_trip(self, tmp_path):
+        log = ProvenanceLog("peer0", pid=2, wall_t0=50.0)
+        log.record("tx", input_datagram(1), ("peer", 1))
+        p = tmp_path / "prov.jsonl"
+        assert log.export_jsonl(str(p)) == 1
+        lines = [json.loads(l) for l in p.read_text().splitlines()]
+        assert lines[0]["meta"] == {
+            "component": "peer0", "pid": 2, "wall_t0": 50.0,
+        }
+        assert lines[1]["dir"] == "tx" and lines[1]["frame"] == 1
+
+
+def run_relayed_pair(tmp_path, frames=90):
+    """Two peers whose only transport is a relay, each raw socket (and
+    the relay's) wrapped in a sidecar tap; returns the exported
+    per-component provenance paths + a relay trace path."""
+    net = LoopbackNetwork()
+    logs = []
+
+    def tap(sock, component, pid):
+        log = ProvenanceLog(component, pid=pid, clock=lambda: net.now)
+        logs.append(log)
+        return SidecarSocket(sock, log)
+
+    relay_tracer = SpanTracer(clock=lambda: net.now, pid=100,
+                              process_name="relay")
+    relay = RelayServer(
+        tap(net.socket(("relay", 0)), "relay", 100),
+        clock=lambda: net.now, tracer=relay_tracer,
+    )
+    peers = []
+    for me in range(2):
+        rsock = RelaySocket(
+            tap(net.socket(("peer", me)), f"peer{me}", me),
+            [("relay", 0)], session_id=1, peer_id=me,
+            clock=lambda: net.now,
+        )
+        builder = (
+            SessionBuilder(box_game.INPUT_SPEC)
+            .with_num_players(2)
+            .with_max_prediction_window(8)
+        )
+        for h in range(2):
+            builder.add_player(
+                PlayerType.local() if h == me
+                else PlayerType.remote(peer_addr(h)), h,
+            )
+        session = builder.start_p2p_session(rsock, clock=lambda: net.now)
+        runner = RollbackRunner(
+            box_game.make_schedule(), box_game.make_world(2).commit(),
+            max_prediction=8, num_players=2,
+            input_spec=box_game.INPUT_SPEC,
+        )
+        peers.append((session, runner))
+    for _ in range(frames):
+        net.advance(FPS_DT)
+        relay.pump(net.now)
+        for session, runner in peers:
+            session.poll_remote_clients()
+            if session.current_state() != SessionState.RUNNING:
+                continue
+            for h in session.local_player_handles():
+                session.add_local_input(h, scripted_input(
+                    h, session.current_frame))
+            try:
+                runner.handle_requests(session.advance_frame(), session)
+            except PredictionThreshold:
+                pass
+    assert all(s.current_frame >= 40 for s, _ in peers)
+    prov_paths = []
+    for log in logs:
+        p = tmp_path / f"{log.component}.jsonl"
+        log.export_jsonl(str(p))
+        prov_paths.append(str(p))
+    trace_path = tmp_path / "relay_trace.json"
+    relay_tracer.export_perfetto(str(trace_path))
+    return prov_paths, str(trace_path)
+
+
+class TestCrossProcessFlows:
+    def test_one_input_spans_four_hops_in_causal_order(self, tmp_path):
+        """Acceptance: follow one input peer0 -> relay -> peer1. The
+        verbatim-forwarding relay gives all four hops the same digest;
+        the chain comes back tx -> rx -> tx -> rx across components even
+        at identical virtual timestamps."""
+        prov_paths, _ = run_relayed_pair(tmp_path)
+        flows = frame_flows(prov_paths, 30)
+        four_hop = {
+            k: chain for k, chain in flows.items() if len(chain) == 4
+        }
+        assert four_hop, "no input reached all four hops"
+        for key, chain in four_hop.items():
+            comps = [c for c, _ in chain]
+            dirs = [r["dir"] for _, r in chain]
+            assert dirs == ["tx", "rx", "tx", "rx"]
+            assert comps[1] == comps[2] == "relay"
+            assert {comps[0], comps[3]} <= {"peer0", "peer1"}
+            assert comps[0] != comps[3]
+            # follow() on the key reproduces the same chain.
+            assert follow(prov_paths, key) == chain
+            # Every hop agrees on the wire form (the envelope).
+            assert {r["type"] for _, r in chain} == {"relay_forward"}
+            assert {r["inner"] for _, r in chain} == {"input"}
+
+    def test_merged_trace_links_hops_with_flow_events(self, tmp_path):
+        prov_paths, trace_path = run_relayed_pair(tmp_path)
+        out = tmp_path / "merged.json"
+        trace = merge_traces([trace_path], prov_paths, path=str(out))
+        assert json.loads(out.read_text()) == trace
+        ev = trace["traceEvents"]
+        # Every provenance component got a named wire track.
+        wire_tracks = {
+            e["args"]["name"]
+            for e in ev
+            if e["ph"] == "M" and e["name"] == "thread_name"
+            and e["args"]["name"].startswith("wire:")
+        }
+        assert wire_tracks == {"wire:relay", "wire:peer0", "wire:peer1"}
+        # Flow chains exist, start/step/finish balanced, and every flow
+        # event lands at a (pid, tid, ts) where a wire slice exists.
+        starts = [e for e in ev if e["ph"] == "s"]
+        finishes = [e for e in ev if e["ph"] == "f"]
+        assert starts and len(starts) == len(finishes)
+        slices = {
+            (e["pid"], e["tid"], e["ts"])
+            for e in ev if e["ph"] == "X"
+        }
+        for e in ev:
+            if e["ph"] in ("s", "t", "f"):
+                assert e["tid"] == WIRE_TID
+                assert (e["pid"], e["tid"], e["ts"]) in slices
+        # At least one flow id spans three distinct processes.
+        flow_pids = {}
+        for e in ev:
+            if e["ph"] in ("s", "t", "f"):
+                flow_pids.setdefault(e["id"], set()).add(e["pid"])
+        assert any(len(pids) >= 3 for pids in flow_pids.values())
+
+    def test_pid_collision_between_files_is_remapped(self, tmp_path):
+        a, b = SpanTracer(pid=0, process_name="a"), SpanTracer(
+            pid=0, process_name="b")
+        for t in (a, b):
+            with t.span("net_poll"):
+                pass
+        pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+        a.export_perfetto(str(pa))
+        b.export_perfetto(str(pb))
+        trace = merge_traces([str(pa), str(pb)])
+        pids = {
+            e["args"]["name"]: e["pid"]
+            for e in trace["traceEvents"]
+            if e.get("name") == "process_name"
+        }
+        assert pids["a"] != pids["b"]
+
+    def test_wall_alignment_shifts_by_anchor_delta(self, tmp_path):
+        a = SpanTracer(pid=0, process_name="a", wall_t0=100.0)
+        b = SpanTracer(pid=1, process_name="b", wall_t0=100.5)
+        for t in (a, b):
+            with t.span("net_poll"):
+                pass
+        pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+        a.export_perfetto(str(pa))
+        b.export_perfetto(str(pb))
+        trace = merge_traces([str(pa), str(pb)], align="wall")
+        ts_by_pid = {}
+        for e in trace["traceEvents"]:
+            if e["ph"] == "B":
+                ts_by_pid[e["pid"]] = e["ts"]
+        # b's events moved +500ms relative to a's (anchor = min wall_t0).
+        assert ts_by_pid[1] - ts_by_pid[0] == pytest.approx(500_000, abs=2_000)
+
+    def test_cli_merges_and_reports(self, tmp_path, capsys):
+        prov_paths, trace_path = run_relayed_pair(tmp_path)
+        out = tmp_path / "cli_merged.json"
+        rc = merge_main(
+            [trace_path, "--provenance", *prov_paths, "--out", str(out)]
+        )
+        assert rc == 0
+        assert "flow hops" in capsys.readouterr().out
+        assert json.loads(out.read_text())["traceEvents"]

@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""CI bench-regression gate: diff fresh smoke-bench rows against the last
+committed baseline (``BENCH_r0*.json`` / ``BENCH_DETAIL.json``) with
+noise-aware thresholds.
+
+Design constraints this encodes:
+
+- **Platform honesty.** Committed baselines are TPU rows; CI smoke runs on
+  the CPU backend. Comparing absolute latencies across backends is
+  meaningless, so a value check only arms when the baseline row's
+  ``platform`` matches the current row's. Mismatches still run the schema
+  health checks (the part that catches a silently broken bench) and are
+  reported as ``skipped``.
+- **Noise awareness.** A regression needs BOTH a relative excess
+  (``--rel-tol``, default 35%) and an absolute one (``--abs-tol``,
+  default 0.05 ms) over baseline — sub-0.1 ms rows live inside host timer
+  jitter, and a pure ratio would page on them forever.
+- **Schema health.** Every row must carry metric/value/unit with value>0,
+  and every ``serve_batched_*`` row must carry its device-time attribution
+  verdict (``attr_verdict``) — the serve bench without attribution is a
+  regression even when the latency looks fine.
+
+Usage (CI)::
+
+    python bench.py --config serve_batched_box_game_S16 > serve-smoke.json
+    python tools/bench_gate.py serve-smoke.json --report bench_gate.html
+
+Exit 0 = all rows pass (or skipped with reason); exit 1 = regression or
+health failure, with a self-contained HTML diff written via ``--report``
+for the failure-artifact upload.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import html
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_rows(path: str) -> List[dict]:
+    """Bench rows from any artifact shape this repo produces: a single
+    row dict (``bench.py --config`` stdout), a list of rows, the
+    ``BENCH_DETAIL.json`` ``{"configs": [...]}`` wrapper, the driver's
+    ``BENCH_r0N.json`` ``{"parsed": {...}}`` wrapper, or JSON lines."""
+    with open(path) as f:
+        text = f.read().strip()
+    if not text:
+        return []
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        rows = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue  # interleaved log noise
+            if isinstance(row, dict) and "metric" in row:
+                rows.append(row)
+        return rows
+    if isinstance(obj, list):
+        return [r for r in obj if isinstance(r, dict) and "metric" in r]
+    if isinstance(obj, dict):
+        if "metric" in obj:
+            return [obj]
+        if isinstance(obj.get("configs"), list):
+            return [r for r in obj["configs"] if "metric" in r]
+        if isinstance(obj.get("parsed"), dict) and "metric" in obj["parsed"]:
+            return [obj["parsed"]]
+    return []
+
+
+def default_baselines() -> List[str]:
+    """Committed baseline files, oldest first so the newest round's row
+    wins when a metric appears in several."""
+    paths = sorted(glob.glob(os.path.join(REPO_ROOT, "BENCH_r0*.json")))
+    detail = os.path.join(REPO_ROOT, "BENCH_DETAIL.json")
+    if os.path.exists(detail):
+        paths.insert(0, detail)
+    return paths
+
+
+def collect_baselines(paths: List[str]) -> Dict[str, dict]:
+    base: Dict[str, dict] = {}
+    for p in paths:
+        for row in load_rows(p):
+            base[row["metric"]] = row
+    return base
+
+
+def check_row(row: dict, base: Optional[dict],
+              rel_tol: float, abs_tol: float) -> dict:
+    """One verdict dict: {metric, status, detail, value, baseline}."""
+    metric = row.get("metric", "?")
+    out = {"metric": metric, "value": row.get("value"),
+           "baseline": base.get("value") if base else None}
+    # Schema health — platform-independent, always enforced.
+    v = row.get("value")
+    if not isinstance(v, (int, float)) or v <= 0 or row.get("unit") != "ms":
+        out.update(status="FAIL",
+                   detail=f"malformed row: value={v!r} unit={row.get('unit')!r}")
+        return out
+    if metric.startswith("serve_batched_") and not row.get("attr_verdict"):
+        out.update(status="FAIL",
+                   detail="serve row lost its device-time attribution verdict")
+        return out
+    if base is None:
+        out.update(status="skipped", detail="no committed baseline row")
+        return out
+    bplat, cplat = base.get("platform"), row.get("platform")
+    if bplat != cplat:
+        out.update(
+            status="skipped",
+            detail=f"platform mismatch (baseline {bplat}, current {cplat}); "
+                   "health checks only",
+        )
+        return out
+    limit = base["value"] * (1.0 + rel_tol) + abs_tol
+    if v > limit:
+        out.update(
+            status="FAIL",
+            detail=f"{v:.3f} ms > allowed {limit:.3f} ms "
+                   f"(baseline {base['value']:.3f} ms, "
+                   f"+{rel_tol:.0%} rel +{abs_tol} ms abs)",
+        )
+    else:
+        out.update(status="ok",
+                   detail=f"{v:.3f} ms <= allowed {limit:.3f} ms")
+    return out
+
+
+_COLORS = {"ok": "#9ece6a", "skipped": "#e0af68", "FAIL": "#f7768e"}
+
+
+def write_report(path: str, verdicts: List[dict]) -> None:
+    rows = "\n".join(
+        "<tr><td>{m}</td><td style='color:{c}'>{s}</td>"
+        "<td>{v}</td><td>{b}</td><td>{d}</td></tr>".format(
+            m=html.escape(str(r["metric"])),
+            c=_COLORS.get(r["status"], "#c0caf5"), s=r["status"],
+            v="-" if r["value"] is None else r["value"],
+            b="-" if r["baseline"] is None else r["baseline"],
+            d=html.escape(str(r["detail"])),
+        )
+        for r in verdicts
+    )
+    doc = (
+        "<!doctype html><meta charset='utf-8'><title>bench gate</title>"
+        "<style>body{background:#1a1b26;color:#c0caf5;"
+        "font:14px/1.5 monospace;padding:2em}table{border-collapse:"
+        "collapse}td,th{border:1px solid #3b4261;padding:.3em .8em;"
+        "text-align:left}</style><h1>Bench regression gate</h1>"
+        f"<table><tr><th>metric</th><th>status</th><th>value (ms)</th>"
+        f"<th>baseline (ms)</th><th>detail</th></tr>{rows}</table>"
+    )
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        f.write(doc)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("current", nargs="+",
+                    help="fresh bench row files (bench.py stdout)")
+    ap.add_argument("--baseline", nargs="*", default=None,
+                    help="baseline files (default: committed BENCH_r0*)")
+    ap.add_argument("--rel-tol", type=float, default=0.35,
+                    help="relative excess over baseline tolerated")
+    ap.add_argument("--abs-tol", type=float, default=0.05,
+                    help="absolute excess (ms) tolerated on top")
+    ap.add_argument("--report", default=None,
+                    help="write a self-contained HTML verdict table here")
+    args = ap.parse_args(argv)
+
+    baselines = collect_baselines(
+        args.baseline if args.baseline is not None else default_baselines()
+    )
+    verdicts: List[dict] = []
+    for path in args.current:
+        rows = load_rows(path)
+        if not rows:
+            verdicts.append({
+                "metric": path, "value": None, "baseline": None,
+                "status": "FAIL", "detail": "no bench rows parsed",
+            })
+            continue
+        for row in rows:
+            verdicts.append(check_row(
+                row, baselines.get(row["metric"]),
+                args.rel_tol, args.abs_tol,
+            ))
+
+    failed = [v for v in verdicts if v["status"] == "FAIL"]
+    for v in verdicts:
+        print(f"[{v['status']:>7}] {v['metric']}: {v['detail']}")
+    if args.report:
+        write_report(args.report, verdicts)
+        print(f"gate report -> {args.report}")
+    print(f"bench gate: {len(verdicts)} row(s), {len(failed)} failure(s)")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
