@@ -2222,6 +2222,237 @@ def _serve_chaos_case(S: int) -> dict:
         shutil.rmtree(ckpt_dir, ignore_errors=True)
 
 
+# Fleet tier (fleet/, docs/serving.md): S matches split across TWO
+# supervised MatchServers under a FleetBalancer. Headline value is the
+# healthy fleet-tick p50; the robustness columns are live-migration
+# stall p50/p99 (destination frames served between drain and readmit),
+# server-loss failover recovery p50/p99 (checkpoint replay debt +
+# detection downtime, per fault class), matches_lost and
+# churn_recompiles — both gated at zero.
+_FLEET_CONFIGS = {"fleet_migrate_S64": 64}
+
+
+def _fleet_migrate_case(S: int) -> dict:
+    import shutil
+    import tempfile
+
+    from bevy_ggrs_tpu.fleet import FleetBalancer
+    from bevy_ggrs_tpu.models import box_game
+    from bevy_ggrs_tpu.serve import MatchServer
+    from bevy_ggrs_tpu.session.builder import SessionBuilder
+    from bevy_ggrs_tpu.transport.loopback import LoopbackNetwork
+    from bevy_ggrs_tpu.utils import xla_cache
+    from bevy_ggrs_tpu.utils.metrics import Metrics
+
+    P, MAXPRED, B, F = 2, 4, 8, 3
+    # Capacity leaves headroom above S/2 so the survivor can absorb the
+    # dead server's whole checkpoint on top of its own matches plus the
+    # measured migrations (32 home + 1 warm + 8 migrated + 24 failover).
+    CAP, GROUPS = S + 4, 4
+    RAMP, N_MIG, MIG_AT, MIG_EVERY = 30, 8, 40, 10
+    kill_at = 200
+    ticks = int(os.environ.get("GGRS_FLEET_TICKS", "290") or "290")
+    ticks = max(ticks, 290)
+    rtt0 = _host_device_rtt_ms()
+    xla_cache.install_compile_listeners()
+
+    def make_synctest():
+        return (
+            SessionBuilder(box_game.INPUT_SPEC)
+            .with_num_players(P)
+            .with_max_prediction_window(MAXPRED)
+            .with_check_distance(2)
+            .start_synctest_session()
+        )
+
+    def inputs_for(seed):
+        def f(frame, handle):
+            return np.uint8((frame * 3 + handle * 5 + seed) % 16)
+
+        return f
+
+    ckpt_root = tempfile.mkdtemp(prefix="ggrs_fleet_migrate_")
+    net = LoopbackNetwork()
+    metrics = Metrics()
+    bal = FleetBalancer(
+        socket=net.socket(("fleet", "bal")),
+        addr=("fleet", "bal"),
+        heartbeat_timeout=0.5,
+        clock=lambda: net.now,
+        metrics=metrics,
+    )
+
+    def build(k):
+        server = MatchServer(
+            box_game.make_schedule(), box_game.make_world(P).commit(),
+            MAXPRED, P, box_game.INPUT_SPEC,
+            num_branches=B, spec_frames=F, capacity=CAP,
+            stagger_groups=GROUPS, metrics=metrics,
+            clock=lambda: net.now,
+            checkpoint_dir=os.path.join(ckpt_root, f"srv{k}"),
+            checkpoint_interval=60, checkpoint_keep=3,
+            server_id=k, fleet_socket=net.socket(("hb", k)),
+            fleet_addr=("fleet", "bal"), heartbeat_interval=8,
+        )
+        server.warmup()
+        bal.register(
+            k, server, addr=("mig", k), sock=net.socket(("mig", k)),
+            checkpoint_dir=os.path.join(ckpt_root, f"srv{k}"),
+        )
+        return server
+
+    try:
+        servers = {k: build(k) for k in range(2)}
+        for m in range(S):
+            bal.place_match(
+                m, make_synctest(), inputs_for(m), server_id=m % 2
+            )
+        # The warm dummy lives on the survivor so server 0's checkpoints
+        # hold only real matches.
+        WARM = 10_000
+        bal.place_match(
+            WARM, make_synctest(), inputs_for(WARM), server_id=1
+        )
+        # Ramp, then warm the churn paths once per server (suspend ->
+        # wire -> readmit round-trip; first-use tracing is warmup's
+        # business, same contract the fleet tests pin) before the
+        # fault-churn compile segment begins.
+        for _ in range(RAMP):
+            net.advance(1.0 / 60.0)
+            for srv in servers.values():
+                srv.run_frame()
+            bal.pump()
+        for warm_dst in (0, 1):
+            warm = bal.begin_migration(WARM, dst_id=warm_dst)
+            net.advance(0.0)
+            assert bal.complete_migration(warm) is not None
+        compiles_base = xla_cache.compile_counters()["backend_compiles"]
+
+        times = []  # (tick_ms, in_flight, post_kill)
+        stalls = []
+        per_class = {}
+        pending = None
+        mig_iter = iter(range(N_MIG))
+        next_mig = next(mig_iter)
+        pre_kill = {}
+        detected_tick = None
+        recovered = []
+        for t in range(RAMP, ticks):
+            net.advance(1.0 / 60.0)
+            if t == kill_at:
+                # Server loss: the process is gone. Its matches' frames
+                # are snapshotted for the recovery-debt ledger; the
+                # balancer only learns through heartbeat silence.
+                pre_kill = {
+                    m_id: servers[0].groups[pl.handle.group]
+                    .slots[pl.handle.slot].frame
+                    for m_id, pl in bal.placements.items()
+                    if pl.server_id == 0
+                }
+                del servers[0]
+            t0 = time.perf_counter()
+            for srv in servers.values():
+                srv.run_frame()
+                for core in srv.groups:
+                    jax.block_until_ready(core.states)
+            times.append(
+                ((time.perf_counter() - t0) * 1000.0,
+                 pending is not None, t >= kill_at)
+            )
+            if pending is not None:
+                mig, ready_at = pending
+                # The balancer's control loop only reaches the
+                # completion step every few ticks: the stall each match
+                # sees is frames served by the destination in between.
+                if t >= ready_at and bal.complete_migration(mig) is not None:
+                    stalls.append(float(mig.stall_frames))
+                    pending = None
+            elif (next_mig is not None and t >= MIG_AT
+                  and t == MIG_AT + next_mig * MIG_EVERY):
+                mig = bal.begin_migration(2 * next_mig, dst_id=1)
+                pending = (mig, t + 1 + (next_mig % 3))
+                next_mig = next(mig_iter, None)
+            bal.pump()
+            for dead in bal.check():
+                detected_tick = t
+                recovered = bal.failover(dead)
+                survivor = bal.members[1].server
+                down = detected_tick - kill_at
+                per_class["server_loss"] = [
+                    float(pre_kill[m_id]
+                          - survivor.groups[h.group].slots[h.slot].frame
+                          + down)
+                    for m_id, _sid, h in recovered
+                ]
+        churn_recompiles = (
+            xla_cache.compile_counters()["backend_compiles"] - compiles_base
+        )
+
+        survivor = bal.members[1].server
+        healthy = [ms for ms, mig, post in times if not mig and not post]
+        stalled = [ms for ms, mig, _ in times if mig]
+        healthy_p50 = float(np.percentile(healthy, 50))
+        all_on_survivor = all(
+            pl.server_id == 1 for pl in bal.placements.values()
+        )
+        recovery_cols = {}
+        for reason, vals in sorted(per_class.items()):
+            recovery_cols[f"recovery_p50_frames_{reason}"] = float(
+                np.percentile(vals, 50)
+            )
+            recovery_cols[f"recovery_p99_frames_{reason}"] = float(
+                np.percentile(vals, 99)
+            )
+            recovery_cols[f"recovery_events_{reason}"] = len(vals)
+        td = _bench_trace_dir(f"fleet_migrate_S{S}")
+        if td is not None:
+            survivor.export_telemetry(td, prefix=f"fleet_migrate_S{S}")
+        return _entry(
+            f"fleet_migrate_S{S}",
+            healthy_p50, S, B,
+            rtt_ms=rtt0,
+            sessions=S,
+            model="box_game",
+            servers=2,
+            ticks=len(times),
+            tick_p50_healthy_ms=round(healthy_p50, 4),
+            tick_p50_migrating_ms=round(
+                float(np.percentile(stalled, 50)), 4
+            ) if stalled else None,
+            migrations_measured=len(stalls),
+            migrations_completed=int(bal.migrations_completed),
+            migrations_aborted=int(bal.migrations_aborted),
+            migration_stall_p50_frames=float(np.percentile(stalls, 50)),
+            migration_stall_p99_frames=float(np.percentile(stalls, 99)),
+            failover_detect_ticks=(
+                int(detected_tick - kill_at)
+                if detected_tick is not None else None
+            ),
+            failovers=int(bal.failovers),
+            matches_recovered=int(bal.matches_recovered),
+            matches_lost=int(bal.matches_lost),
+            all_matches_on_survivor=bool(all_on_survivor),
+            survivor_cache_size=int(survivor.cache_size()),
+            churn_recompiles=int(churn_recompiles),
+            **recovery_cols,
+            notes=(
+                f"{len(stalls)} live migrations (drain -> type 18-21 "
+                "wire -> digest-guarded readmit) under load, then a "
+                "server loss at tick 200 detected by 0.5 s heartbeat "
+                "silence and failed over from the last checkpoint "
+                "(interval 60f) onto the survivor; migration stall is "
+                "destination frames served between drain and readmit "
+                "(bounded by the balancer control-loop cadence); "
+                "server_loss recovery is checkpoint replay debt + "
+                "detection downtime; gated on matches_lost == 0 and "
+                "churn_recompiles == 0 (warm round-trip segmented out, "
+                "same contract tests/test_fleet.py pins bitwise)"
+            ),
+        )
+    finally:
+        shutil.rmtree(ckpt_root, ignore_errors=True)
+
+
 # _cpuhost variants force the CPU backend (a LOCAL device): they
 # demonstrate the framework's host path meets the render deadline when
 # dispatch isn't tunnel-bound — the fair live reading for this
@@ -2263,6 +2494,8 @@ def run_config(name: str) -> dict:
         return _serve_batched_case(model, S)
     if name in _SERVE_CHAOS_CONFIGS:
         return _serve_chaos_case(_SERVE_CHAOS_CONFIGS[name])
+    if name in _FLEET_CONFIGS:
+        return _fleet_migrate_case(_FLEET_CONFIGS[name])
     if name in _LIVE_CONFIGS:
         model, speculate, transport = _LIVE_CONFIGS[name]
         rtt0 = _host_device_rtt_ms()
@@ -2287,7 +2520,8 @@ def run_matrix() -> list:
     for name in (list(_CONFIGS) + list(_RECOVERY_CONFIGS)
                  + list(_LIVE_CONFIGS) + list(_EIGHTP_CONFIGS)
                  + list(_MULTIHOST_CONFIGS) + list(_RELAY_CONFIGS)
-                 + list(_SERVE_CONFIGS) + list(_SERVE_CHAOS_CONFIGS)):
+                 + list(_SERVE_CONFIGS) + list(_SERVE_CHAOS_CONFIGS)
+                 + list(_FLEET_CONFIGS)):
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--config", name],
             capture_output=True, text=True, cwd=os.path.dirname(
@@ -2374,7 +2608,8 @@ def main() -> None:
         valid = (list(_CONFIGS) + list(_RECOVERY_CONFIGS)
                  + list(_LIVE_CONFIGS) + list(_EIGHTP_CONFIGS)
                  + list(_MULTIHOST_CONFIGS) + list(_RELAY_CONFIGS)
-                 + list(_SERVE_CONFIGS) + list(_SERVE_CHAOS_CONFIGS))
+                 + list(_SERVE_CONFIGS) + list(_SERVE_CHAOS_CONFIGS)
+                 + list(_FLEET_CONFIGS))
         if idx >= len(args) or args[idx] not in valid:
             print(f"bench: --config needs one of: {', '.join(valid)}",
                   file=sys.stderr)

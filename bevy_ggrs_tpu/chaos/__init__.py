@@ -11,27 +11,33 @@ reproducible from the plan's seed (docs/chaos.md).
 """
 
 from bevy_ggrs_tpu.chaos.plan import (
+    BalancerPartition,
     ChaosPlan,
     Corrupt,
     Duplicate,
     KillRestart,
     LossBurst,
+    MigrateMatch,
     Partition,
     RelayKillRestart,
     Reorder,
     ServerKillRestart,
+    ServerLoss,
 )
 from bevy_ggrs_tpu.chaos.socket import ChaosSocket
 
 __all__ = [
+    "BalancerPartition",
     "ChaosPlan",
     "ChaosSocket",
     "Corrupt",
     "Duplicate",
     "KillRestart",
     "LossBurst",
+    "MigrateMatch",
     "Partition",
     "RelayKillRestart",
     "Reorder",
     "ServerKillRestart",
+    "ServerLoss",
 ]

@@ -119,9 +119,54 @@ class ServerKillRestart:
     down_for: float
 
 
+@dataclasses.dataclass(frozen=True)
+class BalancerPartition:
+    """Silence the fleet CONTROL plane for one server: heartbeats (and any
+    migration traffic) between ``server`` and the balancer drop while the
+    window is open. Unlike :class:`Partition` this is about the balancer's
+    false-positive discipline — a server that is alive and serving but
+    unheard must not be declared dead before ``grace`` (the balancer's
+    heartbeat timeout) of CONTINUOUS silence, and a window shorter than
+    that must cause zero failovers. Enforced at the fleet-socket level
+    (the harness or the balancer's pump consults
+    :meth:`ChaosPlan.balancer_partitioned`)."""
+
+    start: float
+    end: float
+    server: object
+
+
+@dataclasses.dataclass(frozen=True)
+class MigrateMatch:
+    """Script a FORCED live migration: at ``at``, the balancer drains
+    match ``match_id`` from server ``src`` and readmits it on ``dst``
+    through the digest-guarded snapshot wire. Harness/balancer-level like
+    the kill family — sockets can't move matches — but carried in the
+    plan so a fleet soak's migration schedule replays from its seed."""
+
+    at: float
+    match_id: int
+    src: object
+    dst: object
+
+
+@dataclasses.dataclass(frozen=True)
+class ServerLoss:
+    """Script a PERMANENT server death (no restart — the difference from
+    :class:`ServerKillRestart`): server ``server`` dies at ``at`` and
+    never comes back. The balancer must detect the loss by heartbeat
+    silence and restore the dead server's matches from its last fleet
+    checkpoint onto SURVIVING servers (synctest bitwise, P2P via donor
+    rejoin). Harness-level execution, replayable from the plan."""
+
+    at: float
+    server: object
+
+
 Directive = Union[
     LossBurst, Reorder, Duplicate, Corrupt, Partition, KillRestart,
-    RelayKillRestart, ServerKillRestart,
+    RelayKillRestart, ServerKillRestart, BalancerPartition, MigrateMatch,
+    ServerLoss,
 ]
 
 _KINDS = {
@@ -133,6 +178,9 @@ _KINDS = {
     "kill_restart": KillRestart,
     "relay_kill_restart": RelayKillRestart,
     "server_kill_restart": ServerKillRestart,
+    "balancer_partition": BalancerPartition,
+    "migrate_match": MigrateMatch,
+    "server_loss": ServerLoss,
 }
 _NAMES = {cls: name for name, cls in _KINDS.items()}
 
@@ -193,18 +241,38 @@ class ChaosPlan:
             key=lambda d: d.at,
         )
 
+    def balancer_partitioned(self, server, now: float) -> bool:
+        return any(
+            isinstance(d, BalancerPartition)
+            and d.server == server
+            and d.start <= now < d.end
+            for d in self.directives
+        )
+
+    def migrations(self) -> List[MigrateMatch]:
+        return sorted(
+            (d for d in self.directives if isinstance(d, MigrateMatch)),
+            key=lambda d: d.at,
+        )
+
+    def server_losses(self) -> List[ServerLoss]:
+        return sorted(
+            (d for d in self.directives if isinstance(d, ServerLoss)),
+            key=lambda d: d.at,
+        )
+
     def horizon(self) -> float:
         """Time at which the last directive has expired/healed."""
         t = 0.0
         for d in self.directives:
-            t = max(
-                t,
-                d.at + d.down_for
-                if isinstance(
-                    d, (KillRestart, RelayKillRestart, ServerKillRestart)
-                )
-                else d.end,
-            )
+            if isinstance(
+                d, (KillRestart, RelayKillRestart, ServerKillRestart)
+            ):
+                t = max(t, d.at + d.down_for)
+            elif isinstance(d, (MigrateMatch, ServerLoss)):
+                t = max(t, d.at)
+            else:
+                t = max(t, d.end)
         return t
 
     # -- (de)serialization: the replay artifact --------------------------
@@ -245,6 +313,8 @@ class ChaosPlan:
         kill_restart: bool = False,
         relay: Optional[object] = None,
         match_server: Optional[object] = None,
+        fleet: Tuple[object, ...] = (),
+        fleet_matches: int = 0,
     ) -> "ChaosPlan":
         """A deterministic mixed-fault schedule over ``duration`` seconds:
         a few loss bursts, one reorder window, one duplication window, one
@@ -252,8 +322,15 @@ class ChaosPlan:
         window, (opt-in) one peer kill/restart, when ``relay`` names a
         relay address one scripted relay kill/restart, and — when
         ``match_server`` names a serve-tier process — one scripted
-        :class:`ServerKillRestart`. Same ``(seed, duration, peers, relay,
-        match_server)`` -> same plan, always."""
+        :class:`ServerKillRestart`. When ``fleet`` names ≥1 server ids the
+        fleet family rides along: one :class:`BalancerPartition` (control-
+        plane silence on a random member), with ≥2 members plus a
+        ``fleet_matches`` domain one forced :class:`MigrateMatch`, and
+        with ≥2 members one :class:`ServerLoss` late in the run. Fleet
+        draws come AFTER every pre-existing draw, so adding them never
+        perturbs the loss/reorder/kill schedule an older seed produced.
+        Same ``(seed, duration, peers, relay, match_server, fleet,
+        fleet_matches)`` -> same plan, always."""
         rng = np.random.RandomState(seed & 0x7FFFFFFF)
         span = max(float(duration), 1.0)
         d: List[Directive] = []
@@ -290,4 +367,26 @@ class ChaosPlan:
             t0 = float(rng.uniform(0.55 * span, 0.75 * span))
             d.append(ServerKillRestart(t0, match_server,
                                        float(rng.uniform(0.04, 0.08) * span)))
+        if fleet:
+            # Fleet family — drawn LAST so every earlier stream (and
+            # therefore every pre-fleet plan a seed ever produced) is
+            # byte-identical with or without these.
+            victim = fleet[int(rng.randint(0, len(fleet)))]
+            t0 = float(rng.uniform(0.15 * span, 0.4 * span))
+            d.append(BalancerPartition(
+                t0, t0 + float(rng.uniform(0.02, 0.05) * span), victim))
+            if len(fleet) >= 2 and fleet_matches > 0:
+                src_i = int(rng.randint(0, len(fleet)))
+                dst_i = (
+                    src_i + 1 + int(rng.randint(0, len(fleet) - 1))
+                ) % len(fleet)
+                mid = int(rng.randint(0, fleet_matches))
+                t0 = float(rng.uniform(0.3 * span, 0.5 * span))
+                d.append(MigrateMatch(t0, mid, fleet[src_i], fleet[dst_i]))
+            if len(fleet) >= 2:
+                # Late, after the migration and every network window: the
+                # failover must land on a fleet already scarred by chaos.
+                t0 = float(rng.uniform(0.6 * span, 0.8 * span))
+                d.append(ServerLoss(
+                    t0, fleet[int(rng.randint(0, len(fleet)))]))
         return cls(seed, tuple(d))

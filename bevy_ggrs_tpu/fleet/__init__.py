@@ -1,0 +1,35 @@
+"""Fleet tier: health-driven balancing across N MatchServers.
+
+One MatchServer is one fault domain — PR 9 gave it slot quarantine and
+crash-restart, but a fleet of servers needs the layer above: who gets the
+next match, how a live match moves OFF a burning server without its
+players noticing, and what happens when a whole server disappears.
+:class:`~bevy_ggrs_tpu.fleet.balancer.FleetBalancer` is that layer:
+
+- **Placement** scores every member by its last
+  :class:`~bevy_ggrs_tpu.session.protocol.FleetHeartbeat` (SLO pages,
+  quarantined slots, occupancy) and admits at the least-burning server's
+  least-loaded stagger group.
+- **Live migration** drains a match through the server's extract path
+  into a digest-guarded :func:`~bevy_ggrs_tpu.serve.faults.
+  pack_match_record` blob, ships it over the type 18–21 migration wire,
+  and readmits it bitwise-continuously on the destination — with an
+  abort path that readmits the retained ticket at the source, so a
+  corrupt blob or a refusing destination never loses the match.
+- **Server-loss failover** turns heartbeat silence past the balancer's
+  timeout into recovery: the dead server's matches re-seed from its last
+  on-disk fleet checkpoint onto surviving servers (synctest bitwise, P2P
+  via supervisor donor rejoin).
+
+docs/serving.md "Fleet tier" covers the policy math; docs/chaos.md lists
+the fleet fault model (BalancerPartition / MigrateMatch / ServerLoss).
+"""
+
+from bevy_ggrs_tpu.fleet.balancer import (
+    FleetBalancer,
+    FleetMember,
+    Migration,
+    Placement,
+)
+
+__all__ = ["FleetBalancer", "FleetMember", "Migration", "Placement"]

@@ -1,0 +1,623 @@
+"""FleetBalancer: placement, live migration, and server-loss failover.
+
+The balancer is a control-plane process: it never simulates a frame and
+never touches a session's inputs. Its inputs are the per-server
+:class:`~bevy_ggrs_tpu.session.protocol.FleetHeartbeat` beacons (SLO
+pages, quarantine counts, occupancy) arriving on its socket; its outputs
+are admissions, migrations and failovers performed through the public
+MatchServer surface (``add_match`` / ``suspend_match`` /
+``resume_match`` / ``adopt_rejoin``).
+
+Design invariants, in order of importance:
+
+1. **No match is ever lost by a migration.** The source's
+   :class:`~bevy_ggrs_tpu.serve.faults.SlotTicket` is retained until the
+   destination verified the wire blob's integrity digest and readmitted;
+   any failure — refused offer, missing chunk, CRC or digest mismatch —
+   aborts by readmitting the retained ticket at the source's original
+   (group, slot).
+2. **Migration is bitwise.** The destination readmits from the
+   WIRE-DECODED ticket (not the in-memory one), so a passing soak proves
+   the full encode → chunk → reassemble → decode path preserves the
+   trajectory exactly.
+3. **Silence is not death until the timeout says so.** A
+   :class:`~bevy_ggrs_tpu.chaos.plan.BalancerPartition` shorter than
+   ``heartbeat_timeout`` must produce zero failovers — the false-positive
+   discipline docs/chaos.md specifies.
+4. **Churn never compiles.** Placement lands on existing batched slots;
+   migration readmits through the traced-index admit path; failover uses
+   the same resume/adopt paths crash-restart uses. A fleet soak asserts
+   ``cache_size() == 1`` per server end to end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Callable, Dict, List, Optional, Tuple
+
+from bevy_ggrs_tpu.serve.faults import (
+    SlotTicket,
+    load_checkpoint_matches,
+    pack_match_record,
+    unpack_match_record,
+)
+from bevy_ggrs_tpu.serve.server import MatchHandle
+from bevy_ggrs_tpu.session import protocol as proto
+
+__all__ = ["FleetBalancer", "FleetMember", "Migration", "Placement"]
+
+#: Migration blob fragments mirror the relay keyframe chunking.
+CHUNK_PAYLOAD = 1024
+
+
+def _is_p2p(session) -> bool:
+    # Mirrors serve.server._supervisable: ballots + control channel mark
+    # a session whose state lives on the network, not in a state_dict.
+    return hasattr(session, "checksum_votes") and hasattr(
+        session, "drain_control"
+    )
+
+
+class _LiveSlotView:
+    """Runner-shaped view of wherever a served match currently lives —
+    batched slot or recovery lane — resolved per read, so a
+    :class:`~bevy_ggrs_tpu.relay.stream.StatePublisher` re-pointed here
+    stays correct through lane drains and readmissions after a
+    migration/failover hop."""
+
+    def __init__(self, server, handle: MatchHandle):
+        self._server = server
+        self._handle = handle
+
+    def _runner(self):
+        lane = self._server._lanes.get(self._handle)
+        return None if lane is None else lane.runner
+
+    @property
+    def state(self):
+        r = self._runner()
+        if r is not None:
+            return r.state
+        return self._server.groups[self._handle.group].slot_state(
+            self._handle.slot
+        )
+
+    @property
+    def ring(self):
+        r = self._runner()
+        if r is not None:
+            return r.ring
+        return self._server.groups[self._handle.group].slot_ring(
+            self._handle.slot
+        )
+
+    @property
+    def frame(self) -> int:
+        r = self._runner()
+        if r is not None:
+            return int(r.frame)
+        return self._server.groups[self._handle.group].slots[
+            self._handle.slot
+        ].frame
+
+    @property
+    def max_prediction(self) -> int:
+        return self._server.groups[self._handle.group].max_prediction
+
+
+@dataclasses.dataclass
+class FleetMember:
+    """One supervised server: the live object (None once dead), its
+    migration-endpoint address + socket, its checkpoint directory (the
+    failover source of truth), and the freshest heartbeat."""
+
+    server_id: int
+    server: object
+    addr: object = None
+    sock: object = None
+    checkpoint_dir: Optional[str] = None
+    alive: bool = True
+    last_beat: Optional[float] = None
+    info: Optional[proto.FleetHeartbeat] = None
+
+
+@dataclasses.dataclass
+class Placement:
+    """The balancer's book entry for one fleet-managed match — everything
+    failover needs to re-establish it without asking anyone."""
+
+    match_id: int
+    server_id: int
+    handle: MatchHandle
+    session: object
+    local_inputs: Optional[Callable[[int, int], object]] = None
+    donor: object = None  # P2P failover rejoin donor (surviving peer addr)
+    publisher: object = None  # StatePublisher to rehost across hops
+
+
+@dataclasses.dataclass
+class Migration:
+    """In-flight live migration state. ``ticket`` is the retained source
+    ticket — the abort path's guarantee that the match survives any wire
+    failure. ``resolved`` goes True exactly once, via readmit-at-dst or
+    abort-back-to-src."""
+
+    nonce: int
+    match_id: int
+    src_id: int
+    dst_id: int
+    src_handle: MatchHandle
+    ticket: SlotTicket
+    frame: int
+    total: int
+    digest: int
+    begun_dst_frames: int
+    chunks: Dict[int, bytes] = dataclasses.field(default_factory=dict)
+    offer_seen: bool = False
+    done_seen: bool = False
+    accepted: Optional[bool] = None
+    resolved: bool = False
+    aborted: bool = False
+    dst_handle: Optional[MatchHandle] = None
+    stall_frames: Optional[int] = None
+
+
+class FleetBalancer:
+    def __init__(
+        self,
+        socket=None,
+        addr=None,
+        heartbeat_timeout: float = 0.5,
+        clock: Optional[Callable[[], float]] = None,
+        plan=None,
+        metrics=None,
+        tracer=None,
+    ):
+        import time as _time
+
+        from bevy_ggrs_tpu.obs.trace import null_tracer
+        from bevy_ggrs_tpu.utils.metrics import null_metrics
+
+        self.socket = socket
+        self.addr = addr
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self._clock = clock if clock is not None else _time.monotonic
+        # Chaos plan consulted for BalancerPartition windows: a partitioned
+        # member's heartbeats are dropped at ingest, modelling control-plane
+        # silence without touching the data plane.
+        self.plan = plan
+        self.metrics = metrics if metrics is not None else null_metrics
+        self.tracer = tracer if tracer is not None else null_tracer
+        self.members: Dict[int, FleetMember] = {}
+        self.placements: Dict[int, Placement] = {}
+        self._nonce = 0
+        self.migrations_begun = 0
+        self.migrations_completed = 0
+        self.migrations_aborted = 0
+        self.failovers = 0
+        self.matches_recovered = 0
+        self.matches_lost = 0
+
+    # -- membership ------------------------------------------------------
+
+    def register(
+        self,
+        server_id: int,
+        server,
+        addr=None,
+        sock=None,
+        checkpoint_dir: Optional[str] = None,
+    ) -> FleetMember:
+        m = FleetMember(
+            server_id=int(server_id),
+            server=server,
+            addr=addr,
+            sock=sock,
+            checkpoint_dir=checkpoint_dir,
+            last_beat=self._clock(),
+        )
+        self.members[m.server_id] = m
+        return m
+
+    def _alive(self) -> List[FleetMember]:
+        return [
+            m
+            for m in self.members.values()
+            if m.alive and m.server is not None
+        ]
+
+    # -- heartbeats + death detection ------------------------------------
+
+    def pump(self, now: Optional[float] = None) -> int:
+        """Drain the balancer socket: every decodable
+        :class:`FleetHeartbeat` refreshes its member's liveness clock and
+        load picture. Heartbeats from a member inside a
+        :class:`BalancerPartition` window are dropped — the balancer is
+        deliberately deaf to them, which is exactly the condition its
+        false-positive discipline is tested under. Returns heartbeats
+        applied."""
+        if self.socket is None:
+            return 0
+        now = self._clock() if now is None else float(now)
+        applied = 0
+        for _addr, data in self.socket.receive_all():
+            msg = proto.decode(data)
+            if not isinstance(msg, proto.FleetHeartbeat):
+                continue
+            if self.plan is not None and self.plan.balancer_partitioned(
+                msg.server_id, now
+            ):
+                self.metrics.count("fleet_heartbeats_dropped")
+                continue
+            member = self.members.get(msg.server_id)
+            if member is None:
+                continue
+            member.last_beat = now
+            member.info = msg
+            applied += 1
+            self.metrics.count("fleet_heartbeats_rx")
+        return applied
+
+    def check(self, now: Optional[float] = None) -> List[int]:
+        """Declare members dead after ``heartbeat_timeout`` of CONTINUOUS
+        silence; returns newly-dead server ids (the caller triggers
+        :meth:`failover` — detection and recovery are separate acts so a
+        harness can interleave them with frame serving)."""
+        now = self._clock() if now is None else float(now)
+        dead: List[int] = []
+        for m in self.members.values():
+            if not m.alive or m.last_beat is None:
+                continue
+            if now - m.last_beat > self.heartbeat_timeout:
+                m.alive = False
+                dead.append(m.server_id)
+                self.metrics.count("fleet_servers_dead")
+                self.tracer.instant(
+                    "fleet_server_dead",
+                    server=m.server_id,
+                    silent_for=now - m.last_beat,
+                )
+        return dead
+
+    # -- placement -------------------------------------------------------
+
+    def _score(self, m: FleetMember) -> float:
+        """Lower is better. Heartbeat-derived burn: SLO pages dominate,
+        quarantined/recovering slots next, occupancy breaks ties —
+        so a healthy-but-full server loses to a healthy-and-empty one
+        and any paging server loses to both."""
+        hb = m.info if m.info is not None else m.server.heartbeat()
+        total = max(1, hb.slots_active + hb.slots_free)
+        return (
+            100.0 * hb.pages
+            + 25.0 * hb.quarantined
+            + hb.slots_active / total
+        )
+
+    def place(self, exclude: Tuple[int, ...] = ()) -> FleetMember:
+        """The least-burning live member with a free slot."""
+        candidates = [
+            m
+            for m in self._alive()
+            if m.server_id not in exclude
+            and m.server.free_slot_handles()
+        ]
+        if not candidates:
+            raise RuntimeError("fleet has no admittable server")
+        return min(candidates, key=lambda m: (self._score(m), m.server_id))
+
+    def place_match(
+        self,
+        match_id: int,
+        session,
+        local_inputs: Optional[Callable[[int, int], object]] = None,
+        initial_state=None,
+        spec_on: bool = True,
+        donor=None,
+        publisher=None,
+        server_id: Optional[int] = None,
+    ) -> Tuple[int, MatchHandle]:
+        """Fleet-level admission: pick a server (or honor the pin), admit
+        at its least-loaded stagger group, book the placement."""
+        member = (
+            self.members[server_id]
+            if server_id is not None
+            else self.place()
+        )
+        handle = member.server.add_match(
+            session,
+            local_inputs,
+            initial_state=initial_state,
+            spec_on=spec_on,
+        )
+        self.placements[int(match_id)] = Placement(
+            match_id=int(match_id),
+            server_id=member.server_id,
+            handle=handle,
+            session=session,
+            local_inputs=local_inputs,
+            donor=donor,
+            publisher=publisher,
+        )
+        self.metrics.count("fleet_placements")
+        self.tracer.instant(
+            "fleet_place",
+            match=int(match_id),
+            server=member.server_id,
+            group=handle.group,
+            slot=handle.slot,
+        )
+        return member.server_id, handle
+
+    # -- live migration --------------------------------------------------
+
+    def begin_migration(
+        self, match_id: int, dst_id: Optional[int] = None
+    ) -> Migration:
+        """Drain ``match_id`` off its server and ship its snapshot to the
+        destination over the type 18–21 wire: one MigrateOffer carrying
+        the whole-blob digest, CRC-guarded chunks, one MigrateDone. The
+        source slot frees immediately (the bounded stall begins); the
+        retained ticket keeps the abort path open until
+        :meth:`complete_migration` resolves."""
+        pl = self.placements[int(match_id)]
+        src = self.members[pl.server_id]
+        dst = (
+            self.members[dst_id]
+            if dst_id is not None
+            else self.place(exclude=(pl.server_id,))
+        )
+        if dst.server_id == src.server_id:
+            raise ValueError("migration destination is the source")
+        self._nonce = (self._nonce + 1) & 0xFFFFFFFF
+        nonce = self._nonce
+        with self.tracer.span(
+            "fleet_migrate",
+            phase="begin",
+            match=pl.match_id,
+            src=src.server_id,
+            dst=dst.server_id,
+        ):
+            session_state = None
+            if not _is_p2p(pl.session):
+                sd = getattr(pl.session, "state_dict", None)
+                session_state = sd() if sd is not None else None
+            ticket = src.server.suspend_match(pl.handle)
+            blob = pack_match_record(
+                src.server.state_codec(),
+                {
+                    "handle": pl.handle,
+                    "kind": "p2p" if _is_p2p(pl.session) else "synctest",
+                    "frame": ticket.frame,
+                    "state": ticket.state,
+                    "ring": ticket.ring,
+                    "input_log": ticket.input_log,
+                    "spec_on": ticket.spec_on,
+                    "session_state": session_state,
+                },
+            )
+            from bevy_ggrs_tpu.relay.delta import payload_digest
+
+            digest = payload_digest(blob)
+            chunks = [
+                blob[i : i + CHUNK_PAYLOAD]
+                for i in range(0, len(blob), CHUNK_PAYLOAD)
+            ] or [b""]
+            total = len(chunks)
+            src.sock.send_to(
+                proto.encode(
+                    proto.MigrateOffer(
+                        nonce, pl.match_id, ticket.frame, total, digest
+                    )
+                ),
+                dst.addr,
+            )
+            for seq, payload in enumerate(chunks):
+                src.sock.send_to(
+                    proto.encode(
+                        proto.MigrateChunk(
+                            nonce,
+                            ticket.frame,
+                            seq,
+                            total,
+                            zlib.crc32(payload) & 0xFFFFFFFF,
+                            payload,
+                        )
+                    ),
+                    dst.addr,
+                )
+                self.metrics.count("fleet_migrate_bytes", len(payload))
+            src.sock.send_to(
+                proto.encode(proto.MigrateDone(nonce, ticket.frame, 1)),
+                dst.addr,
+            )
+        self.migrations_begun += 1
+        self.metrics.count("fleet_migrations_begun")
+        return Migration(
+            nonce=nonce,
+            match_id=pl.match_id,
+            src_id=src.server_id,
+            dst_id=dst.server_id,
+            src_handle=pl.handle,
+            ticket=ticket,
+            frame=ticket.frame,
+            total=total,
+            digest=digest,
+            begun_dst_frames=dst.server.frames_served,
+        )
+
+    def _abort_migration(self, mig: Migration, reason: str) -> None:
+        pl = self.placements[mig.match_id]
+        src = self.members[mig.src_id]
+        # The source slot was freed by suspend and is not reserved, so the
+        # retained ticket readmits at the exact original (group, slot).
+        handle = src.server.resume_match(
+            pl.session, pl.local_inputs, mig.ticket, handle=mig.src_handle
+        )
+        pl.server_id, pl.handle = src.server_id, handle
+        mig.resolved, mig.aborted = True, True
+        self.migrations_aborted += 1
+        self.metrics.count("fleet_migrations_aborted")
+        self.tracer.instant(
+            "fleet_migrate_abort", match=mig.match_id, reason=reason
+        )
+
+    def complete_migration(self, mig: Migration) -> Optional[MatchHandle]:
+        """Destination-side progress: drain the destination's migration
+        socket, ack the offer, reassemble chunks. Once the blob is whole
+        it must pass the offer digest AND the in-blob state digest before
+        the WIRE-DECODED ticket readmits at the destination's least-loaded
+        group; any failure aborts back to the source. Returns the new
+        handle when resolved-forward, None while in flight or after an
+        abort (check ``mig.aborted``). Call repeatedly between frames."""
+        if mig.resolved:
+            return mig.dst_handle
+        src = self.members[mig.src_id]
+        dst = self.members[mig.dst_id]
+        for _addr, data in dst.sock.receive_all():
+            msg = proto.decode(data)
+            if msg is None or getattr(msg, "nonce", None) != mig.nonce:
+                continue
+            if isinstance(msg, proto.MigrateOffer):
+                mig.offer_seen = True
+                accept = bool(dst.server.free_slot_handles())
+                dst.sock.send_to(
+                    proto.encode(proto.MigrateAccept(mig.nonce, accept)),
+                    src.addr,
+                )
+                if not accept:
+                    self._abort_migration(mig, "offer_refused")
+                    return None
+            elif isinstance(msg, proto.MigrateChunk):
+                if zlib.crc32(msg.payload) & 0xFFFFFFFF != msg.crc:
+                    self._abort_migration(mig, "chunk_crc")
+                    return None
+                mig.chunks[msg.seq] = msg.payload
+            elif isinstance(msg, proto.MigrateDone):
+                mig.done_seen = True
+        # Source side only learns the accept verdict; a refusal already
+        # aborted above, so this drain is bookkeeping.
+        for _addr, data in src.sock.receive_all():
+            msg = proto.decode(data)
+            if (
+                isinstance(msg, proto.MigrateAccept)
+                and msg.nonce == mig.nonce
+            ):
+                mig.accepted = bool(msg.accept)
+        if not (mig.done_seen and len(mig.chunks) == mig.total):
+            return None
+        blob = b"".join(mig.chunks[i] for i in range(mig.total))
+        from bevy_ggrs_tpu.relay.delta import payload_digest
+
+        if payload_digest(blob) != mig.digest:
+            self._abort_migration(mig, "blob_digest")
+            return None
+        try:
+            rec = unpack_match_record(dst.server.state_codec(), blob)
+        except ValueError:
+            self._abort_migration(mig, "record_digest")
+            return None
+        pl = self.placements[mig.match_id]
+        with self.tracer.span(
+            "fleet_migrate",
+            phase="readmit",
+            match=mig.match_id,
+            src=mig.src_id,
+            dst=mig.dst_id,
+            frame=rec["frame"],
+        ):
+            handle = dst.server.resume_match(
+                pl.session, pl.local_inputs, rec["ticket"]
+            )
+        pl.server_id, pl.handle = dst.server_id, handle
+        if pl.publisher is not None:
+            pl.publisher.rehost(
+                runner=_LiveSlotView(dst.server, handle)
+            )
+        mig.resolved, mig.dst_handle = True, handle
+        mig.stall_frames = dst.server.frames_served - mig.begun_dst_frames
+        self.migrations_completed += 1
+        self.metrics.count("fleet_migrations_completed")
+        self.metrics.observe(
+            "fleet_migration_stall_frames", mig.stall_frames
+        )
+        return handle
+
+    # -- server-loss failover --------------------------------------------
+
+    def failover(self, dead_id: int) -> List[Tuple[int, int, MatchHandle]]:
+        """Recover a dead server's matches from its last on-disk
+        checkpoint onto surviving members: synctest matches resume
+        bitwise at the checkpoint frame (session rewound via its saved
+        state_dict), P2P matches adopt-rejoin from their booked donor.
+        Matches with no checkpoint record (admitted after the last save)
+        or no recovery path are counted lost and unbooked — the soak
+        gate requires that count to be zero. Returns
+        ``[(match_id, server_id, handle), ...]`` for the recovered."""
+        member = self.members[dead_id]
+        member.alive = False
+        member.server = None
+        self.failovers += 1
+        self.metrics.count("fleet_failovers")
+        by_key = {
+            (pl.handle.group, pl.handle.slot): pl
+            for pl in self.placements.values()
+            if pl.server_id == dead_id
+        }
+        recovered: List[Tuple[int, int, MatchHandle]] = []
+        records: List[Dict] = []
+        if member.checkpoint_dir is not None and self._alive():
+            from bevy_ggrs_tpu.serve.faults import ServerCheckpointer
+
+            path = ServerCheckpointer(member.checkpoint_dir).latest()
+            if path is not None:
+                codec = self._alive()[0].server.state_codec()
+                records = load_checkpoint_matches(path, codec)
+        seen = set()
+        for rec in records:
+            pl = by_key.get(rec["key"])
+            if pl is None:
+                continue  # retired since the save
+            seen.add(rec["key"])
+            survivor = self.place(exclude=(dead_id,))
+            with self.tracer.span(
+                "fleet_failover",
+                match=pl.match_id,
+                dead=dead_id,
+                to=survivor.server_id,
+                kind=rec["kind"],
+                frame=rec["frame"],
+            ):
+                if rec["kind"] == "synctest":
+                    if rec["session_state"] is not None:
+                        pl.session.load_state_dict(rec["session_state"])
+                    handle = survivor.server.resume_match(
+                        pl.session, pl.local_inputs, rec["ticket"]
+                    )
+                else:
+                    handle = survivor.server.free_slot_handles()[0]
+                    handle = survivor.server.adopt_rejoin(
+                        handle, pl.session, pl.local_inputs, pl.donor
+                    )
+            pl.server_id, pl.handle = survivor.server_id, handle
+            if pl.publisher is not None:
+                pl.publisher.rehost(
+                    runner=_LiveSlotView(survivor.server, handle)
+                )
+            recovered.append((pl.match_id, survivor.server_id, handle))
+            self.matches_recovered += 1
+            self.metrics.count("fleet_matches_recovered")
+            self.metrics.observe(
+                "fleet_failover_restored_frame", rec["frame"]
+            )
+        for key, pl in by_key.items():
+            if key in seen:
+                continue
+            self.placements.pop(pl.match_id, None)
+            self.matches_lost += 1
+            self.metrics.count("fleet_matches_lost")
+            self.tracer.instant(
+                "fleet_match_lost", match=pl.match_id, dead=dead_id
+            )
+        return recovered

@@ -60,6 +60,11 @@ _TYPE_TAGS = {
     protocol.T_STREAM_DELTA: "stream_delta",
     protocol.T_STREAM_KEYFRAME: "stream_keyframe",
     protocol.T_STREAM_ACK: "stream_ack",
+    protocol.T_MIGRATE_OFFER: "migrate_offer",
+    protocol.T_MIGRATE_ACCEPT: "migrate_accept",
+    protocol.T_MIGRATE_CHUNK: "migrate_chunk",
+    protocol.T_MIGRATE_DONE: "migrate_done",
+    protocol.T_FLEET_HEARTBEAT: "fleet_heartbeat",
 }
 
 
@@ -99,6 +104,12 @@ def _classify(data: bytes) -> Tuple[str, Optional[int], Optional[str]]:
             frame = protocol._STREAM_DELTA.unpack_from(body)[0]
         elif mtype == protocol.T_STREAM_KEYFRAME:
             frame = protocol._STREAM_KF.unpack_from(body)[0]
+        elif mtype == protocol.T_MIGRATE_OFFER:
+            frame = protocol._MIG_OFFER.unpack_from(body)[2]
+        elif mtype == protocol.T_MIGRATE_CHUNK:
+            frame = protocol._MIG_CHUNK.unpack_from(body)[1]
+        elif mtype == protocol.T_MIGRATE_DONE:
+            frame = protocol._MIG_DONE.unpack_from(body)[1]
         elif mtype == protocol.T_RELAY_FORWARD:
             inner, frame, _ = _classify(body[protocol._RELAY_FWD.size:])
     except Exception:

@@ -72,6 +72,23 @@ class StatePublisher:
 
     # ------------------------------------------------------------------
 
+    def rehost(self, runner=None, socket=None, session=None) -> None:
+        """Re-point the publisher at a new runner/socket/session after a
+        live cross-server match migration. The delta chain state
+        (``_prev``/``_prev_frame``) is KEPT — the destination server
+        resumed the match bitwise, so the last published payload is still
+        the true chain base — but the next published frame is forced to
+        be a keyframe so any spectator whose chain walk straddles the hop
+        resyncs from a checkpoint instead of degrading. Spectator cursors
+        survive: a keyframe at frame > cursor always supersedes."""
+        if runner is not None:
+            self.runner = runner
+        if socket is not None:
+            self.socket = socket
+        if session is not None:
+            self.session = session
+        self._since_keyframe = self.keyframe_interval
+
     def _send(self, msg: proto.Message) -> None:
         data = proto.encode(msg)
         self.socket.send_to(data, self.relay_addr)

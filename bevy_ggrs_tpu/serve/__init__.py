@@ -20,6 +20,9 @@ from bevy_ggrs_tpu.serve.faults import (
     SlotHealth,
     SlotHealthFSM,
     SlotTicket,
+    load_checkpoint_matches,
+    pack_match_record,
+    unpack_match_record,
 )
 from bevy_ggrs_tpu.serve.server import MatchHandle, MatchServer
 
@@ -34,4 +37,7 @@ __all__ = [
     "SlotHealth",
     "SlotHealthFSM",
     "SlotTicket",
+    "load_checkpoint_matches",
+    "pack_match_record",
+    "unpack_match_record",
 ]

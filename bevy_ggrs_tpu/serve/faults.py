@@ -63,6 +63,9 @@ __all__ = [
     "SlotTicket",
     "RecoveryLane",
     "ServerCheckpointer",
+    "pack_match_record",
+    "unpack_match_record",
+    "load_checkpoint_matches",
 ]
 
 
@@ -417,6 +420,199 @@ _HEADER_KEY = "__ggrs_server_header__"
 _CKPT_VERSION = 1
 
 
+def _encode_match(codec, j: int, snap: Dict) -> Tuple[Dict, Dict]:
+    """One snapshot_matches() record -> (npz arrays keyed ``m{j}_*``,
+    header entry). The single shared serializer behind whole-server
+    checkpoints AND per-match migration blobs — one format, one digest
+    discipline."""
+    from bevy_ggrs_tpu.relay.delta import payload_digest
+    from bevy_ggrs_tpu.state import to_host
+
+    arrays: Dict[str, np.ndarray] = {}
+    state_bytes = codec.encode(to_host(snap["state"]))
+    ring = snap["ring"]
+    depth = int(ring.frames.shape[0])
+    ring_rows = np.stack(
+        [
+            np.frombuffer(
+                codec.encode(to_host(_ring_row(ring.states, d))),
+                dtype=np.uint8,
+            )
+            for d in range(depth)
+        ]
+    )
+    log = snap["input_log"]
+    # Tail only: frames the speculation builders / forced-rollback
+    # window can still reach (the rest is GC fodder anyway).
+    tail_from = snap["frame"] - depth - 8
+    frames = sorted(f for f in log if f >= tail_from)
+    log_frames = np.asarray(frames, dtype=np.int64)
+    log_bits = (
+        np.stack([np.asarray(log[f]) for f in frames])
+        if frames
+        else np.zeros((0,), dtype=np.uint8)
+    )
+    arrays[f"m{j}_state"] = np.frombuffer(state_bytes, dtype=np.uint8)
+    arrays[f"m{j}_ring"] = ring_rows
+    arrays[f"m{j}_ring_frames"] = np.asarray(ring.frames, dtype=np.int32)
+    arrays[f"m{j}_ring_cs"] = np.asarray(ring.checksums, dtype=np.uint32)
+    arrays[f"m{j}_log_frames"] = log_frames
+    arrays[f"m{j}_log_bits"] = log_bits
+    handle = snap["handle"]
+    entry = {
+        "j": j,
+        "group": 0 if handle is None else handle.group,
+        "slot": 0 if handle is None else handle.slot,
+        "frame": int(snap["frame"]),
+        "spec_on": bool(snap["spec_on"]),
+        "kind": snap["kind"],
+        "digest": payload_digest(state_bytes),
+        "session_state": snap["session_state"],
+    }
+    return arrays, entry
+
+
+def _decode_ticket(codec, npz, entry: Dict) -> SlotTicket:
+    """Rebuild one match's device-resident :class:`SlotTicket` from its
+    checkpoint arrays. The caller has already digest-verified the state
+    payload. The inverse of :func:`_encode_match`, bitwise."""
+    import jax
+    import jax.numpy as jnp
+
+    from bevy_ggrs_tpu.state import SnapshotRing, WorldState
+
+    j = entry["j"]
+    state = WorldState(**codec.decode(npz[f"m{j}_state"].tobytes()))
+    ring_rows = npz[f"m{j}_ring"]
+    depth = ring_rows.shape[0]
+    row_states = [
+        WorldState(**codec.decode(ring_rows[d].tobytes()))
+        for d in range(depth)
+    ]
+    ring = SnapshotRing(
+        states=jax.tree_util.tree_map(
+            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+            *row_states,
+        ),
+        frames=jnp.asarray(npz[f"m{j}_ring_frames"], dtype=jnp.int32),
+        checksums=jnp.asarray(npz[f"m{j}_ring_cs"], dtype=jnp.uint32),
+    )
+    log_frames = npz[f"m{j}_log_frames"]
+    log_bits = npz[f"m{j}_log_bits"]
+    input_log = {
+        int(f): np.asarray(log_bits[k]) for k, f in enumerate(log_frames)
+    }
+    return SlotTicket(
+        frame=int(entry["frame"]),
+        state=jax.tree_util.tree_map(jnp.asarray, state),
+        ring=ring,
+        input_log=input_log,
+        spec_on=bool(entry["spec_on"]),
+    )
+
+
+def _verify_header(header: Dict, codec, origin: str) -> None:
+    if header.get("version") != _CKPT_VERSION:
+        raise ValueError(
+            f"{origin}: version {header.get('version')} != {_CKPT_VERSION}"
+        )
+    if header["codec_size"] != codec.size:
+        raise ValueError(
+            f"{origin}: state layout is {header['codec_size']} bytes, "
+            f"server template needs {codec.size} — mismatched world "
+            "registry/capacity"
+        )
+
+
+def pack_match_record(codec, snap: Dict) -> bytes:
+    """One match as a self-contained ServerCheckpointer-format blob (the
+    live-migration wire payload): a single-entry checkpoint archive whose
+    header carries the per-match integrity digest. Portable across server
+    instances — nothing in it references the source's slot index, stagger
+    group, or executor beyond the provenance fields in the header."""
+    import io
+
+    arrays, entry = _encode_match(codec, 0, snap)
+    header = json.dumps(
+        {
+            "version": _CKPT_VERSION,
+            "codec_size": int(codec.size),
+            "matches": [entry],
+        }
+    )
+    arrays[_HEADER_KEY] = np.frombuffer(header.encode(), dtype=np.uint8)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    return buf.getvalue()
+
+
+def unpack_match_record(codec, blob: bytes) -> Dict:
+    """Inverse of :func:`pack_match_record`: verify version, codec layout
+    and payload digest, then rebuild the ticket. Raises ``ValueError`` on
+    any mismatch — a corrupt migration blob must abort the move, never
+    readmit a plausible impostor."""
+    import io
+
+    from bevy_ggrs_tpu.relay.delta import payload_digest
+
+    with np.load(io.BytesIO(blob)) as npz:
+        header = json.loads(bytes(npz[_HEADER_KEY]).decode())
+        _verify_header(header, codec, "migration blob")
+        (entry,) = header["matches"]
+        state_bytes = npz[f"m{entry['j']}_state"].tobytes()
+        if payload_digest(state_bytes) != entry["digest"]:
+            raise ValueError(
+                "migration blob: state fails its integrity digest"
+            )
+        return {
+            "kind": entry["kind"],
+            "frame": int(entry["frame"]),
+            "spec_on": bool(entry["spec_on"]),
+            "session_state": entry["session_state"],
+            "source": (int(entry["group"]), int(entry["slot"])),
+            "ticket": _decode_ticket(codec, npz, entry),
+        }
+
+
+def load_checkpoint_matches(path: str, codec) -> List[Dict]:
+    """Read a whole-server checkpoint into per-match records — every entry
+    digest-verified; ``ticket`` decoded for synctest matches (P2P sessions
+    were never serialized, so their recovery is the donor-rejoin path and
+    needs no ticket). The shared loader behind
+    :meth:`ServerCheckpointer.restore` and fleet server-loss failover,
+    which re-seeds a dead server's matches onto SURVIVING servers at
+    whatever slots they have free."""
+    from bevy_ggrs_tpu.relay.delta import payload_digest
+
+    out: List[Dict] = []
+    with np.load(path) as npz:
+        header = json.loads(bytes(npz[_HEADER_KEY]).decode())
+        _verify_header(header, codec, f"server checkpoint {path!r}")
+        for entry in header["matches"]:
+            key = (int(entry["group"]), int(entry["slot"]))
+            state_bytes = npz[f"m{entry['j']}_state"].tobytes()
+            if payload_digest(state_bytes) != entry["digest"]:
+                raise ValueError(
+                    f"server checkpoint {path!r}: slot {key} state "
+                    "fails its integrity digest"
+                )
+            out.append(
+                {
+                    "key": key,
+                    "kind": entry["kind"],
+                    "frame": int(entry["frame"]),
+                    "spec_on": bool(entry["spec_on"]),
+                    "session_state": entry["session_state"],
+                    "ticket": (
+                        _decode_ticket(codec, npz, entry)
+                        if entry["kind"] == "synctest"
+                        else None
+                    ),
+                }
+            )
+    return out
+
+
 class ServerCheckpointer:
     """Rolling on-disk checkpoints of a whole MatchServer.
 
@@ -477,60 +673,13 @@ class ServerCheckpointer:
         return self.save(server)
 
     def save(self, server) -> str:
-        from bevy_ggrs_tpu.relay.delta import payload_digest
-        from bevy_ggrs_tpu.state import to_host
-
         codec = server.state_codec()
         arrays: Dict[str, np.ndarray] = {}
         matches: List[Dict] = []
         for j, snap in enumerate(server.snapshot_matches()):
-            state_bytes = codec.encode(to_host(snap["state"]))
-            ring = snap["ring"]
-            depth = int(ring.frames.shape[0])
-            ring_rows = np.stack(
-                [
-                    np.frombuffer(
-                        codec.encode(
-                            to_host(_ring_row(ring.states, d))
-                        ),
-                        dtype=np.uint8,
-                    )
-                    for d in range(depth)
-                ]
-            )
-            log = snap["input_log"]
-            # Tail only: frames the speculation builders / forced-rollback
-            # window can still reach (the rest is GC fodder anyway).
-            tail_from = snap["frame"] - depth - 8
-            frames = sorted(f for f in log if f >= tail_from)
-            log_frames = np.asarray(frames, dtype=np.int64)
-            log_bits = (
-                np.stack([np.asarray(log[f]) for f in frames])
-                if frames
-                else np.zeros((0,), dtype=np.uint8)
-            )
-            arrays[f"m{j}_state"] = np.frombuffer(state_bytes, dtype=np.uint8)
-            arrays[f"m{j}_ring"] = ring_rows
-            arrays[f"m{j}_ring_frames"] = np.asarray(
-                ring.frames, dtype=np.int32
-            )
-            arrays[f"m{j}_ring_cs"] = np.asarray(
-                ring.checksums, dtype=np.uint32
-            )
-            arrays[f"m{j}_log_frames"] = log_frames
-            arrays[f"m{j}_log_bits"] = log_bits
-            matches.append(
-                {
-                    "j": j,
-                    "group": snap["handle"].group,
-                    "slot": snap["handle"].slot,
-                    "frame": int(snap["frame"]),
-                    "spec_on": bool(snap["spec_on"]),
-                    "kind": snap["kind"],
-                    "digest": payload_digest(state_bytes),
-                    "session_state": snap["session_state"],
-                }
-            )
+            a, entry = _encode_match(codec, j, snap)
+            arrays.update(a)
+            matches.append(entry)
         header = json.dumps(
             {
                 "version": _CKPT_VERSION,
@@ -574,102 +723,42 @@ class ServerCheckpointer:
         checkpoint. Returns the re-established MatchHandles. Raises
         ``ValueError`` on digest/template mismatch — a corrupted checkpoint
         must never silently produce a plausible fleet."""
-        import jax
-        import jax.numpy as jnp
-
-        from bevy_ggrs_tpu.relay.delta import payload_digest
-        from bevy_ggrs_tpu.state import SnapshotRing, WorldState
-
         path = path if path is not None else self.latest()
         if path is None:
             raise ValueError(f"no server checkpoint in {self.directory!r}")
         codec = server.state_codec()
-        with np.load(path) as npz:
-            header = json.loads(bytes(npz[_HEADER_KEY]).decode())
-            if header.get("version") != _CKPT_VERSION:
+        handles = []
+        for rec in load_checkpoint_matches(path, codec):
+            key = rec["key"]
+            att = attachments.get(key)
+            if att is None:
                 raise ValueError(
-                    f"server checkpoint {path!r}: version "
-                    f"{header.get('version')} != {_CKPT_VERSION}"
+                    f"server checkpoint {path!r}: no attachment for "
+                    f"match at group={key[0]} slot={key[1]}"
                 )
-            if header["codec_size"] != codec.size:
-                raise ValueError(
-                    f"server checkpoint {path!r}: state layout is "
-                    f"{header['codec_size']} bytes, server template needs "
-                    f"{codec.size} — mismatched world registry/capacity"
-                )
-            handles = []
-            for e in header["matches"]:
-                key = (int(e["group"]), int(e["slot"]))
-                att = attachments.get(key)
-                if att is None:
-                    raise ValueError(
-                        f"server checkpoint {path!r}: no attachment for "
-                        f"match at group={key[0]} slot={key[1]}"
-                    )
-                j = e["j"]
-                state_bytes = npz[f"m{j}_state"].tobytes()
-                if payload_digest(state_bytes) != e["digest"]:
-                    raise ValueError(
-                        f"server checkpoint {path!r}: slot {key} state "
-                        "fails its integrity digest"
-                    )
-                if e["kind"] != "synctest":
-                    # P2P: the session is live network state we never
-                    # serialized — rejoin from a surviving donor instead.
-                    handles.append(
-                        server.adopt_rejoin(
-                            key,
-                            att["session"],
-                            att.get("local_inputs"),
-                            att["donor"],
-                        )
-                    )
-                    continue
-                state = WorldState(**codec.decode(state_bytes))
-                ring_rows = npz[f"m{j}_ring"]
-                depth = ring_rows.shape[0]
-                row_states = [
-                    WorldState(**codec.decode(ring_rows[d].tobytes()))
-                    for d in range(depth)
-                ]
-                ring = SnapshotRing(
-                    states=jax.tree_util.tree_map(
-                        lambda *xs: jnp.stack(
-                            [jnp.asarray(x) for x in xs]
-                        ),
-                        *row_states,
-                    ),
-                    frames=jnp.asarray(
-                        npz[f"m{j}_ring_frames"], dtype=jnp.int32
-                    ),
-                    checksums=jnp.asarray(
-                        npz[f"m{j}_ring_cs"], dtype=jnp.uint32
-                    ),
-                )
-                log_frames = npz[f"m{j}_log_frames"]
-                log_bits = npz[f"m{j}_log_bits"]
-                input_log = {
-                    int(f): np.asarray(log_bits[k])
-                    for k, f in enumerate(log_frames)
-                }
-                ticket = SlotTicket(
-                    frame=int(e["frame"]),
-                    state=jax.tree_util.tree_map(jnp.asarray, state),
-                    ring=ring,
-                    input_log=input_log,
-                    spec_on=bool(e["spec_on"]),
-                )
-                session = att["session"]
-                if e["session_state"] is not None:
-                    session.load_state_dict(e["session_state"])
+            if rec["kind"] != "synctest":
+                # P2P: the session is live network state we never
+                # serialized — rejoin from a surviving donor instead.
                 handles.append(
-                    server.resume_match(
-                        session,
+                    server.adopt_rejoin(
+                        key,
+                        att["session"],
                         att.get("local_inputs"),
-                        ticket,
-                        handle=key,
+                        att["donor"],
                     )
                 )
+                continue
+            session = att["session"]
+            if rec["session_state"] is not None:
+                session.load_state_dict(rec["session_state"])
+            handles.append(
+                server.resume_match(
+                    session,
+                    att.get("local_inputs"),
+                    rec["ticket"],
+                    handle=key,
+                )
+            )
         return handles
 
 

@@ -124,6 +124,10 @@ class MatchServer:
         slo_config=None,
         slo_export_interval: int = 32,
         trace_dir: Optional[str] = None,
+        server_id: int = 0,
+        fleet_socket=None,
+        fleet_addr=None,
+        heartbeat_interval: int = 8,
     ):
         from bevy_ggrs_tpu.obs.slo import SlotSLO
         from bevy_ggrs_tpu.obs.trace import null_tracer
@@ -207,6 +211,15 @@ class MatchServer:
         self.slo_export_interval = max(1, int(slo_export_interval))
         self.slo_levels: Dict[int, str] = {}
         self.trace_dir = trace_dir
+        # Fleet membership: with a socket + balancer address configured,
+        # the server emits a FleetHeartbeat every heartbeat_interval served
+        # frames — the balancer's liveness signal (missed beats past its
+        # timeout mean THIS server is dead and its matches fail over).
+        self.server_id = int(server_id)
+        self.fleet_socket = fleet_socket
+        self.fleet_addr = fleet_addr
+        self.heartbeat_interval = max(1, int(heartbeat_interval))
+        self.heartbeats_sent = 0
 
     def _flat_slot(self, handle: MatchHandle) -> int:
         """Server-wide slot id (group-qualified) — the SLO/metrics key.
@@ -247,6 +260,37 @@ class MatchServer:
 
     def cache_size(self) -> int:
         return self._exec.cache_size()
+
+    def heartbeat(self):
+        """The liveness + load beacon a :class:`~bevy_ggrs_tpu.fleet.
+        FleetBalancer` consumes — also readable in-process for balancers
+        colocated with their servers."""
+        from bevy_ggrs_tpu.session.protocol import FleetHeartbeat
+
+        return FleetHeartbeat(
+            server_id=self.server_id,
+            frames_served=self.frames_served,
+            slots_active=self.slots_active,
+            slots_free=self.slots_free,
+            quarantined=self.slots_quarantined + self.slots_recovering,
+            pages=sum(
+                1 for lvl in self.slo_levels.values() if lvl == "page"
+            ),
+        )
+
+    def free_slot_handles(self) -> List[MatchHandle]:
+        """Every admittable (group, slot), least-loaded group first — the
+        fleet balancer's stagger-aware placement domain. Reserved slots
+        (recovering matches) are never offered."""
+        order = sorted(
+            range(len(self.groups)),
+            key=lambda g: (-len(self._free_unreserved(g)), g),
+        )
+        return [
+            MatchHandle(g, s)
+            for g in order
+            for s in self._free_unreserved(g)
+        ]
 
     def health_of(self, handle: MatchHandle) -> SlotHealth:
         return self._matches[handle].fsm.state
@@ -744,6 +788,18 @@ class MatchServer:
                 lvl = self.slo_levels.get(self._flat_slot(handle))
                 if lvl is not None:
                     m.fsm.slo_signal(lvl, frame=self.frames_served)
+        if (
+            self.fleet_socket is not None
+            and self.fleet_addr is not None
+            and self.frames_served % self.heartbeat_interval == 0
+        ):
+            from bevy_ggrs_tpu.session import protocol as _proto
+
+            self.fleet_socket.send_to(
+                _proto.encode(self.heartbeat()), self.fleet_addr
+            )
+            self.heartbeats_sent += 1
+            self.metrics.count("fleet_heartbeats_sent")
         if self.checkpointer is not None:
             self.checkpointer.maybe_save(self)
 

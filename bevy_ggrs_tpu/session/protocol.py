@@ -59,6 +59,16 @@ T_SUBSCRIBE = 14
 T_STREAM_DELTA = 15
 T_STREAM_KEYFRAME = 16
 T_STREAM_ACK = 17
+# Fleet tier (bevy_ggrs_tpu/fleet/): live cross-server match migration —
+# offer/accept handshake, chunked digest-guarded snapshot transfer in the
+# ServerCheckpointer blob format, and a commit ack — plus the balancer
+# heartbeat every MatchServer emits. Same no-version-bump rule as the relay
+# family: a fleet-less peer drops these unknown type bytes unharmed.
+T_MIGRATE_OFFER = 18
+T_MIGRATE_ACCEPT = 19
+T_MIGRATE_CHUNK = 20
+T_MIGRATE_DONE = 21
+T_FLEET_HEARTBEAT = 22
 
 # StateRequest.kind values.
 STATE_KIND_RING = 0  # world snapshot at one settled frame (desync resync)
@@ -259,11 +269,81 @@ class StreamAck:
     frame: int
 
 
+@dataclasses.dataclass(frozen=True)
+class MigrateOffer:
+    """Source server -> target server: propose moving one live match.
+    ``nonce`` keys the transfer; ``match_id`` is the fleet-level match
+    identity; ``frame`` the frame the snapshot was drained at; ``total``
+    the chunk count about to follow; ``digest`` the 64-bit payload digest
+    of the whole reassembled ServerCheckpointer-format blob (the target
+    verifies it BEFORE unpacking — a corrupt migration must abort, not
+    readmit a plausible impostor)."""
+
+    nonce: int
+    match_id: int
+    frame: int
+    total: int
+    digest: int
+
+
+@dataclasses.dataclass(frozen=True)
+class MigrateAccept:
+    """Target -> source: ``accept`` 1 reserves capacity for the transfer
+    (0 = at capacity / refusing; the source readmits locally and nothing
+    is lost)."""
+
+    nonce: int
+    accept: int
+
+
+@dataclasses.dataclass(frozen=True)
+class MigrateChunk:
+    """One fragment of the snapshot blob (chunked like
+    :class:`StateChunk`). ``frame`` repeats the offer's drain frame so a
+    passive provenance tap can attribute the fragment to the match's
+    timeline; ``crc`` guards this fragment's bytes."""
+
+    nonce: int
+    frame: int
+    seq: int
+    total: int
+    crc: int
+    payload: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class MigrateDone:
+    """Target -> source: the match readmitted at ``frame`` (``ok`` 1) or
+    the transfer failed digest/unpack (``ok`` 0 — the source readmits its
+    retained ticket; zero matches lost either way)."""
+
+    nonce: int
+    frame: int
+    ok: int
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetHeartbeat:
+    """Server -> balancer liveness + load beacon, sent every
+    ``heartbeat_interval`` served frames. ``pages`` counts slots whose SLO
+    burn level is "page" (the balancer's primary placement repellent);
+    missed beats past the balancer's timeout mark the server dead and
+    trigger checkpoint failover."""
+
+    server_id: int
+    frames_served: int
+    slots_active: int
+    slots_free: int
+    quarantined: int
+    pages: int
+
+
 Message = Union[
     SyncRequest, SyncReply, InputMsg, InputAck, QualityReport, QualityReply,
     KeepAlive, ChecksumReport, StateRequest, StateChunk,
     RelayHello, RelayWelcome, RelayForward, Subscribe,
     StreamDelta, StreamKeyframe, StreamAck,
+    MigrateOffer, MigrateAccept, MigrateChunk, MigrateDone, FleetHeartbeat,
 ]
 
 _U32 = struct.Struct("<I")
@@ -279,6 +359,11 @@ _SUBSCRIBE = struct.Struct("<IiH")  # session_id, cursor, window
 _STREAM_DELTA = struct.Struct("<iiI")  # frame, base_frame, crc
 _STREAM_KF = struct.Struct("<iHHIQ")  # frame, seq, total, crc, digest
 _I32 = struct.Struct("<i")
+_MIG_OFFER = struct.Struct("<IIiHQ")  # nonce, match_id, frame, total, digest
+_MIG_ACCEPT = struct.Struct("<IB")  # nonce, accept
+_MIG_CHUNK = struct.Struct("<IiHHI")  # nonce, frame, seq, total, crc
+_MIG_DONE = struct.Struct("<IiB")  # nonce, frame, ok
+_FLEET_HB = struct.Struct("<HIHHHH")  # id, frames, active, free, quar, pages
 
 
 def encode(msg: Message) -> bytes:
@@ -360,6 +445,34 @@ def encode(msg: Message) -> bytes:
         )
     if isinstance(msg, StreamAck):
         return _HDR.pack(MAGIC, VERSION, T_STREAM_ACK) + _I32.pack(msg.frame)
+    if isinstance(msg, MigrateOffer):
+        return _HDR.pack(MAGIC, VERSION, T_MIGRATE_OFFER) + _MIG_OFFER.pack(
+            msg.nonce & 0xFFFFFFFF, msg.match_id & 0xFFFFFFFF, msg.frame,
+            msg.total & 0xFFFF, msg.digest & 0xFFFFFFFFFFFFFFFF,
+        )
+    if isinstance(msg, MigrateAccept):
+        return _HDR.pack(MAGIC, VERSION, T_MIGRATE_ACCEPT) + _MIG_ACCEPT.pack(
+            msg.nonce & 0xFFFFFFFF, msg.accept & 0xFF
+        )
+    if isinstance(msg, MigrateChunk):
+        return (
+            _HDR.pack(MAGIC, VERSION, T_MIGRATE_CHUNK)
+            + _MIG_CHUNK.pack(
+                msg.nonce & 0xFFFFFFFF, msg.frame, msg.seq & 0xFFFF,
+                msg.total & 0xFFFF, msg.crc & 0xFFFFFFFF,
+            )
+            + msg.payload
+        )
+    if isinstance(msg, MigrateDone):
+        return _HDR.pack(MAGIC, VERSION, T_MIGRATE_DONE) + _MIG_DONE.pack(
+            msg.nonce & 0xFFFFFFFF, msg.frame, msg.ok & 0xFF
+        )
+    if isinstance(msg, FleetHeartbeat):
+        return _HDR.pack(MAGIC, VERSION, T_FLEET_HEARTBEAT) + _FLEET_HB.pack(
+            msg.server_id & 0xFFFF, msg.frames_served & 0xFFFFFFFF,
+            msg.slots_active & 0xFFFF, msg.slots_free & 0xFFFF,
+            msg.quarantined & 0xFFFF, msg.pages & 0xFFFF,
+        )
     raise TypeError(f"unknown message {msg!r}")
 
 
@@ -437,6 +550,25 @@ def decode(data: bytes) -> Optional[Message]:
             )
         if mtype == T_STREAM_ACK:
             return StreamAck(_I32.unpack_from(body)[0])
+        if mtype == T_MIGRATE_OFFER:
+            nonce, mid, frame, total, digest = _MIG_OFFER.unpack_from(body)
+            return MigrateOffer(nonce, mid, frame, total, digest)
+        if mtype == T_MIGRATE_ACCEPT:
+            nonce, accept = _MIG_ACCEPT.unpack_from(body)
+            return MigrateAccept(nonce, accept)
+        if mtype == T_MIGRATE_CHUNK:
+            nonce, frame, seq, total, crc = _MIG_CHUNK.unpack_from(body)
+            return MigrateChunk(
+                nonce, frame, seq, total, crc, body[_MIG_CHUNK.size :]
+            )
+        if mtype == T_MIGRATE_DONE:
+            nonce, frame, ok = _MIG_DONE.unpack_from(body)
+            return MigrateDone(nonce, frame, ok)
+        if mtype == T_FLEET_HEARTBEAT:
+            sid, frames, active, free, quar, pages = _FLEET_HB.unpack_from(
+                body
+            )
+            return FleetHeartbeat(sid, frames, active, free, quar, pages)
         return None
     except struct.error:
         return None

@@ -1,0 +1,500 @@
+"""Fleet tier under chaos: two MatchServers behind a FleetBalancer while
+the network misbehaves, a live match is migrated across servers, and one
+whole server is lost and failed over.
+
+Three layers, mirroring tests/test_serve_chaos.py one tier up:
+
+- Fleet directive plan plumbing — generation (appended AFTER every
+  existing draw family, so fleet args never perturb older schedules),
+  JSON roundtrip, seed replayability.
+- A non-slow smoke: two small servers each hosting real P2P matches; the
+  plan forces one live cross-server migration mid-chaos and a
+  balancer-side control-plane partition shorter than the heartbeat
+  timeout — the migration completes bitwise-invisibly and the partition
+  produces ZERO failovers (silence is not death until the timeout).
+- The slow acceptance soak (S=16 across 2 servers): network chaos + one
+  forced live migration + a real ServerLoss. Zero desyncs, zero matches
+  lost, every match converged on the survivor, churn never recompiled,
+  and one migrated match's confirmed-input log replayed serially from
+  scratch reproduces the recorded checksums bitwise.
+
+ServerLoss is executed at the HARNESS level (a socket can't kill a
+process): the victim's host sockets go dark and its run_frame loop stops;
+the balancer must notice purely from missed heartbeats.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu.chaos import (
+    BalancerPartition,
+    ChaosPlan,
+    Duplicate,
+    LossBurst,
+    MigrateMatch,
+    Partition,
+    Reorder,
+    ServerLoss,
+)
+from bevy_ggrs_tpu.fleet import FleetBalancer
+from bevy_ggrs_tpu.models import box_game
+from bevy_ggrs_tpu.obs import ProvenanceLog, SidecarSocket, SpanTracer, merge_traces
+from bevy_ggrs_tpu.runner import RollbackRunner
+from bevy_ggrs_tpu.serve import MatchServer
+from bevy_ggrs_tpu.session.requests import AdvanceFrame, SaveGameState
+from bevy_ggrs_tpu.session.supervisor import Health
+from bevy_ggrs_tpu.transport.loopback import LoopbackNetwork
+from bevy_ggrs_tpu.utils.metrics import Metrics
+from tests.test_p2p import FPS_DT
+from tests.test_serve_chaos import (
+    BRANCHES,
+    MAX_PRED,
+    SPEC_FRAMES,
+    assert_match_converged,
+    ext_step,
+    make_ext_peer,
+    make_host_session,
+    server_inputs,
+)
+
+
+# ---------------------------------------------------------------------------
+# Fleet directives: plan plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_directives_generated_and_replayable():
+    span = 30.0
+    peers = (("peer", 0), ("peer", 1))
+    plan = ChaosPlan.generate(
+        41, span, peers, kill_restart=True, relay=("relay", 0),
+        match_server=("srv", 0), fleet=(0, 1), fleet_matches=16,
+    )
+    (bp,) = [d for d in plan.directives if isinstance(d, BalancerPartition)]
+    assert bp.server in (0, 1)
+    assert 0.15 * span <= bp.start <= 0.4 * span
+    assert 0.02 * span <= bp.end - bp.start <= 0.05 * span
+    (mig,) = plan.migrations()
+    assert mig.src in (0, 1) and mig.dst in (0, 1) and mig.src != mig.dst
+    assert 0 <= mig.match_id < 16
+    assert 0.3 * span <= mig.at <= 0.5 * span
+    (loss,) = plan.server_losses()
+    assert loss.server in (0, 1)
+    assert 0.6 * span <= loss.at <= 0.8 * span
+    assert plan.horizon() >= loss.at
+    # Same arguments -> the identical plan, always (seed replay).
+    again = ChaosPlan.generate(
+        41, span, peers, kill_restart=True, relay=("relay", 0),
+        match_server=("srv", 0), fleet=(0, 1), fleet_matches=16,
+    )
+    assert again == plan
+    # Fleet draws are appended AFTER every older family: leaving them out
+    # never perturbs the pre-existing schedule (artifact compatibility).
+    without = ChaosPlan.generate(
+        41, span, peers, kill_restart=True, relay=("relay", 0),
+        match_server=("srv", 0),
+    )
+    assert without.directives == plan.directives[:-3]
+
+
+def test_fleet_directives_json_roundtrip():
+    plan = ChaosPlan(
+        7,
+        (
+            LossBurst(1.0, 2.0, 0.2),
+            BalancerPartition(2.0, 2.4, ("hb", 1)),
+            MigrateMatch(3.0, 5, ("mig", 0), ("mig", 1)),
+            ServerLoss(4.0, ("mig", 1)),
+        ),
+    )
+    back = ChaosPlan.from_json(plan.to_json())
+    assert back == plan  # tuple addresses normalized back from JSON lists
+    assert back.balancer_partitioned(("hb", 1), 2.2)
+    assert not back.balancer_partitioned(("hb", 1), 2.5)
+    assert back.migrations()[0].dst == ("mig", 1)
+    assert back.server_losses()[0].server == ("mig", 1)
+    assert back.horizon() >= 4.0
+
+
+# ---------------------------------------------------------------------------
+# Fleet harness: 2 balanced servers, served-P2P matches, harness-level
+# ServerLoss execution, plan-driven live migration
+# ---------------------------------------------------------------------------
+
+
+def build_fleet_server(k, net, metrics, ckpt_dir, capacity, groups,
+                       tracer=None):
+    server = MatchServer(
+        box_game.make_schedule(), box_game.make_world(2).commit(),
+        MAX_PRED, 2, box_game.INPUT_SPEC,
+        capacity=capacity, stagger_groups=groups,
+        num_branches=BRANCHES, spec_frames=SPEC_FRAMES,
+        metrics=metrics, clock=lambda: net.now, tracer=tracer,
+        checkpoint_dir=ckpt_dir, checkpoint_interval=120,
+        server_id=k, fleet_socket=net.socket(("hb", k)),
+        fleet_addr=("fleet", "bal"), heartbeat_interval=8,
+    )
+    server.warmup()
+    return server
+
+
+def run_fleet_soak(plan, n_matches, n_iters, capacity, groups, ckpt_root,
+                   canon_match=None, heartbeat_timeout=0.5):
+    """Drive ``n_matches`` P2P matches balanced across two MatchServers
+    under ``plan``: heartbeats flow to the balancer every iteration,
+    MigrateMatch directives run the live-migration state machine mid-
+    serve, and ServerLoss kills a server at the harness level (sockets
+    dark, frames stop) leaving recovery entirely to heartbeat-timeout
+    detection + checkpoint failover. Returns the state needed by the
+    assertions."""
+    net = LoopbackNetwork()
+    obs_dir = os.environ.get("GGRS_OBS_DIR")
+    prov = {}
+
+    def _tap(sock, component, pid):
+        log = prov.get(component)
+        if log is None:
+            log = prov[component] = ProvenanceLog(
+                component, pid=pid, clock=lambda: net.now
+            )
+        return SidecarSocket(sock, log)
+
+    def server_tap(k):
+        # Host sessions and the migration endpoint of server k share one
+        # per-server provenance log/pid: the merged trace shows a
+        # migrated match's datagrams hopping between the two tracks.
+        if not obs_dir:
+            return None
+        return lambda sock, _c, _p: _tap(sock, f"srv{k}", 500 + k)
+
+    ext_tap = _tap if obs_dir else None
+    tracers = {
+        k: (SpanTracer(clock=lambda: net.now, pid=500 + k,
+                       process_name=f"srv{k}") if obs_dir else None)
+        for k in (0, 1)
+    }
+    metrics = {k: Metrics() for k in (0, 1)}
+    bal = FleetBalancer(
+        socket=net.socket(("fleet", "bal")), addr=("fleet", "bal"),
+        heartbeat_timeout=heartbeat_timeout, clock=lambda: net.now,
+        plan=plan, metrics=Metrics(),
+    )
+    servers = {}
+    for k in (0, 1):
+        ckpt = os.path.join(ckpt_root, f"srv{k}")
+        servers[k] = build_fleet_server(
+            k, net, metrics[k], ckpt, capacity, groups, tracers[k]
+        )
+        msock = net.socket(("mig", k))
+        if obs_dir:
+            msock = _tap(msock, f"srv{k}", 500 + k)
+        bal.register(k, servers[k], addr=("mig", k), sock=msock,
+                     checkpoint_dir=ckpt)
+    ext = {m: make_ext_peer(net, m, plan, ext_tap) for m in range(n_matches)}
+    home = {m: m % 2 for m in range(n_matches)}
+    for m in range(n_matches):
+        bal.place_match(
+            m, make_host_session(net, m, server_tap(home[m])),
+            server_inputs, server_id=home[m], donor=("ext", m),
+        )
+    canon = {} if canon_match is not None else None
+    migs = [{"d": d, "mig": None} for d in plan.migrations()]
+    losses = [
+        {"d": d, "killed": False} for d in plan.server_losses()
+    ]
+    dead_ids = []
+    restore_frame = None
+    faults = []
+    for _ in range(n_iters):
+        net.advance(FPS_DT)
+        for entry in migs:
+            if entry["mig"] is None and net.now >= entry["d"].at:
+                entry["mig"] = bal.begin_migration(
+                    entry["d"].match_id, dst_id=entry["d"].dst
+                )
+            elif entry["mig"] is not None and not entry["mig"].resolved:
+                bal.complete_migration(entry["mig"])
+        for entry in losses:
+            if not entry["killed"] and net.now >= entry["d"].at:
+                victim = servers.pop(entry["d"].server)
+                # kill -9: sockets just go dark, no farewell.
+                for match in victim._matches.values():
+                    match.session.socket.close()
+                entry["killed"] = True
+        for srv in servers.values():
+            srv.run_frame()
+        bal.pump()
+        for dead in bal.check():
+            dead_ids.append(dead)
+            (survivor,) = servers  # the other of the two
+            # The dead server's host sessions died with it: failover
+            # re-establishes each match with a fresh host session that
+            # state-transfers from its external peer (the booked donor).
+            for m, pl in bal.placements.items():
+                if pl.server_id == dead:
+                    pl.session = make_host_session(
+                        net, m, server_tap(survivor)
+                    )
+                    pl.donor = ("ext", m)
+            bal.failover(dead)
+            restore_frame = max(p[0].current_frame for p in ext.values())
+        for m, peer in ext.items():
+            ext_step(net, peer, canon if m == canon_match else None)
+    for peer in ext.values():
+        faults.extend(peer[0].socket.faults)
+    if obs_dir:
+        os.makedirs(obs_dir, exist_ok=True)
+        prov_paths = []
+        for comp, log in prov.items():
+            p = os.path.join(obs_dir, f"fleet_soak_{comp}_provenance.jsonl")
+            log.export_jsonl(p)
+            prov_paths.append(p)
+        trace_paths = []
+        for k, tracer in tracers.items():
+            p = os.path.join(obs_dir, f"fleet_soak_srv{k}_trace.json")
+            tracer.export_perfetto(p)
+            trace_paths.append(p)
+        merge_traces(
+            trace_paths, prov_paths,
+            path=os.path.join(obs_dir, "fleet_soak_merged_trace.json"),
+        )
+    assert all(e["killed"] for e in losses)
+    assert all(e["mig"] is not None for e in migs)
+    return bal, servers, ext, dead_ids, restore_frame, canon, faults, metrics
+
+
+# ---------------------------------------------------------------------------
+# Non-slow smoke: live migration mid-chaos + partition discipline
+# ---------------------------------------------------------------------------
+
+SMOKE_PLAN = ChaosPlan(
+    1717,
+    (
+        LossBurst(1.0, 2.0, 0.2),
+        Duplicate(1.5, 2.5, 0.2),
+        MigrateMatch(3.0, 0, 0, 1),
+        BalancerPartition(5.0, 5.3, 1),
+    ),
+)
+
+
+def run_fleet_smoke(tmp_path, n_iters=480):
+    return run_fleet_soak(
+        SMOKE_PLAN, n_matches=2, n_iters=n_iters, capacity=2, groups=1,
+        ckpt_root=str(tmp_path),
+    )
+
+
+def test_fleet_migration_smoke(tmp_path):
+    bal, servers, ext, dead_ids, _, _, faults, metrics = run_fleet_smoke(
+        tmp_path
+    )
+    # The migration resolved forward: match 0 now lives on server 1,
+    # bitwise-continuously (convergence below), with a bounded stall.
+    assert bal.migrations_completed == 1 and bal.migrations_aborted == 0
+    assert bal.placements[0].server_id == 1
+    assert all(v <= 4 for v in
+               bal.metrics.series["fleet_migration_stall_frames"])
+    assert servers[0].slots_active == 0 and servers[1].slots_active == 2
+    # Partition discipline: 0.3 s of control-plane silence against a
+    # 0.5 s timeout dropped heartbeats but produced ZERO deaths.
+    assert bal.metrics.counters["fleet_heartbeats_dropped"] > 0
+    assert dead_ids == [] and bal.failovers == 0
+    assert all(m.alive for m in bal.members.values())
+    # Both matches converged bitwise past the migration, zero desyncs.
+    for m, pl in bal.placements.items():
+        assert_match_converged(
+            servers[pl.server_id], pl.handle, ext[m], after_frame=200
+        )
+        assert ext[m][3].counters["desyncs_detected"] == 0
+        assert ext[m][2].health in (Health.HEALTHY, Health.DEGRADED)
+    for k in (0, 1):
+        assert metrics[k].counters["desyncs_detected"] == 0
+        assert servers[k].cache_size() == 1
+    assert any(k == "loss" for _, k, _ in faults)
+
+
+def test_fleet_soak_exports_cross_server_migration_trace(
+    tmp_path, monkeypatch
+):
+    """GGRS_OBS_DIR turns the fleet smoke into an artifact producer: a
+    per-server provenance log + span trace and one merged Perfetto
+    timeline in which the migrated match's snapshot datagrams form a
+    flow crossing BOTH servers' tracks — the hop is visible, not
+    inferred."""
+    obs = tmp_path / "obs"
+    monkeypatch.setenv("GGRS_OBS_DIR", str(obs))
+    run_fleet_smoke(tmp_path / "ckpt", n_iters=330)
+    for f in (
+        "fleet_soak_srv0_provenance.jsonl",
+        "fleet_soak_srv1_provenance.jsonl",
+        "fleet_soak_ext0_provenance.jsonl",
+        "fleet_soak_ext1_provenance.jsonl",
+        "fleet_soak_srv0_trace.json",
+        "fleet_soak_srv1_trace.json",
+        "fleet_soak_merged_trace.json",
+    ):
+        p = obs / f
+        assert p.exists() and p.stat().st_size > 0, f"missing artifact {f}"
+
+    # Raw provenance: the same migration datagram (identical flow key)
+    # was recorded tx at srv0 and rx at srv1, frame-attributed.
+    def mig_keys(path, want_dir):
+        out = {}
+        with open(path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if "meta" in rec:
+                    continue
+                if rec["type"] == "migrate_chunk" and rec["dir"] == want_dir:
+                    out[rec["key"]] = rec
+        return out
+
+    tx = mig_keys(obs / "fleet_soak_srv0_provenance.jsonl", "tx")
+    rx = mig_keys(obs / "fleet_soak_srv1_provenance.jsonl", "rx")
+    crossed = set(tx) & set(rx)
+    assert crossed, "no migration chunk recorded at both servers"
+    assert all("frame" in tx[k] for k in crossed)  # drain-frame attributed
+
+    # Merged trace: those datagrams became flow arrows whose hops land on
+    # both server pids (500/501) — the cross-track arrow in Perfetto.
+    with open(obs / "fleet_soak_merged_trace.json") as f:
+        events = json.load(f)["traceEvents"]
+    tracks = {
+        ev["args"]["name"]
+        for ev in events
+        if ev.get("ph") == "M" and ev["name"] == "thread_name"
+    }
+    assert {"wire:srv0", "wire:srv1", "wire:ext0", "wire:ext1"} <= tracks
+    procs = {
+        ev["args"]["name"]
+        for ev in events
+        if ev.get("ph") == "M" and ev["name"] == "process_name"
+    }
+    assert {"srv0", "srv1"} <= procs  # both span tracers' process rows
+    flow_pids = {}
+    for ev in events:
+        if ev.get("cat") == "flow" and ev.get("name") in (
+            "migrate_offer", "migrate_chunk", "migrate_done"
+        ):
+            flow_pids.setdefault(ev["id"], set()).add(ev["pid"])
+    assert any({500, 501} <= pids for pids in flow_pids.values())
+
+
+# ---------------------------------------------------------------------------
+# The slow acceptance soak: S=16 across two servers, migration + loss
+# ---------------------------------------------------------------------------
+
+# Same deliberate omission as the serve-tier soak: no Corrupt window,
+# because InputMsg carries no CRC and a bit-flipped input is a *genuine*
+# divergence (covered by test_chaos_soak.py). This soak isolates the
+# fleet tier's claim: balanced serving + live migration + server-loss
+# failover introduce ZERO desyncs and lose ZERO matches.
+FLEET_SOAK_PLAN = ChaosPlan(
+    3031,
+    (
+        LossBurst(2.0, 4.0, 0.2),
+        LossBurst(8.0, 10.0, 0.25),
+        Reorder(3.0, 6.0, 0.2, delay=0.05),
+        Duplicate(5.0, 7.0, 0.3),
+        Partition(6.0, 6.5, src=("ext", 3)),
+        # Window + worst-case beat phase (8-frame cadence, one-iteration
+        # loopback delivery) must stay under the 0.5 s timeout: 0.25 s of
+        # deafness leaves ~0.4 s max observed silence.
+        BalancerPartition(8.0, 8.25, 1),
+        MigrateMatch(6.0, 0, 0, 1),
+        ServerLoss(12.0, 0),
+    ),
+)
+
+
+@pytest.mark.slow
+def test_fleet_chaos_soak_s16(tmp_path):
+    n = 16
+    bal, servers, ext, dead_ids, restore_frame, canon, faults, metrics = (
+        run_fleet_soak(
+            FLEET_SOAK_PLAN, n_matches=n, n_iters=1100, capacity=n,
+            groups=4, ckpt_root=str(tmp_path), canon_match=0,
+        )
+    )
+    # The server was lost exactly once, detected purely from heartbeat
+    # silence, and every one of its matches was recovered: zero lost.
+    assert dead_ids == [0] and restore_frame is not None
+    assert bal.failovers == 1
+    assert bal.matches_lost == 0
+    assert bal.metrics.counters.get("fleet_matches_lost", 0) == 0
+    # 8 matches homed on server 0, minus match 0 (already live-migrated
+    # to server 1 at t=6): 7 recovered through checkpoint failover.
+    assert bal.matches_recovered == 7
+    assert bal.migrations_completed == 1 and bal.migrations_aborted == 0
+
+    # Everything now lives on the survivor, fully occupied, converged.
+    survivor = servers[1]
+    assert set(servers) == {1}
+    assert survivor.slots_active == n and not survivor._lanes
+    for m, pl in bal.placements.items():
+        assert pl.server_id == 1
+        assert_match_converged(survivor, pl.handle, ext[m], restore_frame)
+        assert ext[m][2].health in (Health.HEALTHY, Health.DEGRADED)
+
+    # Zero desyncs anywhere: the chaos (and the migration, and the
+    # failover) was invisible to every replica's checksum ballots.
+    for m, peer in ext.items():
+        assert peer[3].counters["desyncs_detected"] == 0
+    for k in (0, 1):
+        assert metrics[k].counters["desyncs_detected"] == 0
+
+    # Balancer discipline under chaos: the scripted control-plane
+    # partition dropped beats without triggering a failover (the only
+    # failover is the real loss), and the migration stall was bounded.
+    assert bal.metrics.counters["fleet_heartbeats_dropped"] > 0
+    assert all(v <= 4 for v in
+               bal.metrics.series["fleet_migration_stall_frames"])
+
+    # Churn (migration readmit + 7-match failover) never recompiled the
+    # survivor's rollout executable.
+    assert survivor.cache_size() == 1
+    assert survivor.evictions_total == 0
+
+    # The plan injected every scripted network fault kind.
+    kinds = {k for _, k, _ in faults}
+    assert {"loss", "reorder", "duplicate", "partition"} <= kinds
+
+    # Independent serial replay of the MIGRATED match: rebuild match 0's
+    # trajectory from nothing but its canonical confirmed-input log; the
+    # recorded checksums — which straddle the cross-server hop — must be
+    # bitwise identical.
+    sess = ext[0][0]
+    upto = min(sess.confirmed_frame(), max(canon))
+    assert upto > 700  # the log covers the hop and the failover window
+
+    class Log:
+        def __init__(self):
+            self.seen = {}
+
+        def wants_checksum(self, frame):
+            return True
+
+        def report_checksum(self, frame, cs):
+            self.seen[frame] = int(cs)
+
+    replay = RollbackRunner(
+        box_game.make_schedule(), box_game.make_world(2).commit(),
+        max_prediction=MAX_PRED, num_players=2,
+        input_spec=box_game.INPUT_SPEC,
+    )
+    log = Log()
+    for f in range(upto + 1):
+        bits, status = canon[f]
+        replay.handle_requests(
+            [SaveGameState(f), AdvanceFrame(bits=bits, status=status)], log
+        )
+    recorded = {
+        f: cs for f, cs in sess._local_checksums.items() if f <= upto
+    }
+    assert len(recorded) >= 3
+    for f, cs in recorded.items():
+        assert log.seen[f] == cs, f"serial replay diverged at frame {f}"

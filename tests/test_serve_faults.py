@@ -454,6 +454,87 @@ def test_checkpointer_save_restore_bitwise(tmp_path):
         assert slot_cs(core, h.slot) == slot_cs(rc, r.slot)
 
 
+def test_checkpoint_records_portable_across_server_instances(tmp_path):
+    """Snapshot portability property (the fleet failover precondition):
+    a match record saved by one server restores BITWISE on a server
+    instance that shares nothing with the source but the world template —
+    different slot index, different stagger group, different batch width
+    (hence a different compiled executor) — via both transports: the
+    on-disk checkpoint loader and the pack/unpack migration blob."""
+    import io
+
+    from bevy_ggrs_tpu.serve import (
+        load_checkpoint_matches,
+        pack_match_record,
+        unpack_match_record,
+    )
+    from bevy_ggrs_tpu.state import checksum, combine64
+
+    ckpt = str(tmp_path / "ckpts")
+    # Source: 2 groups x 2 slots. Destination: 3 groups x 1 slot — every
+    # match necessarily lands at a different (group, slot) with a
+    # different per-group batch width.
+    src = make_server(checkpoint_dir=ckpt, checkpoint_interval=6)
+    ref = make_server()
+    seeds = (31, 32, 33)
+    handles = [src.add_match(make_synctest(), inputs_for(k)) for k in seeds]
+    r_handles = [ref.add_match(make_synctest(), inputs_for(k))
+                 for k in seeds]
+    for _ in range(12):
+        src.run_frame()
+        ref.run_frame()
+    want = {
+        (h.group, h.slot): slot_cs(src.groups[h.group], h.slot)
+        for h in handles
+    }
+    # Migration-blob transport: pack one live match, unpack, and the
+    # decoded ticket is bitwise the slot it came from.
+    codec = src.state_codec()
+    snap = src.snapshot_matches()[0]
+    blob = pack_match_record(codec, snap)
+    rec = unpack_match_record(codec, blob)
+    assert rec["frame"] == 12 and rec["kind"] == "synctest"
+    assert combine64(checksum(rec["ticket"].state)) == want[
+        (snap["handle"].group, snap["handle"].slot)
+    ]
+    # Tampered state payload -> digest rejection, never a plausible world.
+    with np.load(io.BytesIO(blob)) as npz:
+        arrays = {k: npz[k] for k in npz.files}
+    arrays["m0_state"] = arrays["m0_state"].copy()
+    arrays["m0_state"][0] ^= 0xFF
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    with pytest.raises(ValueError, match="digest"):
+        unpack_match_record(codec, buf.getvalue())
+
+    path = src.checkpointer.latest()
+    del src  # the source instance is gone; only disk + template remain
+
+    dst = make_server(capacity=3, stagger_groups=3)
+    key_to_seed = {(h.group, h.slot): k for h, k in zip(handles, seeds)}
+    moved = {}
+    for r in load_checkpoint_matches(path, dst.state_codec()):
+        sess = make_synctest()
+        sess.load_state_dict(r["session_state"])
+        h = dst.resume_match(
+            sess, inputs_for(key_to_seed[r["key"]]), r["ticket"]
+        )
+        assert dst.groups[h.group].slots[h.slot].frame == 12
+        assert slot_cs(dst.groups[h.group], h.slot) == want[r["key"]]
+        moved[r["key"]] = h
+    # The resumed trajectories stay bitwise equal to the uninterrupted
+    # reference on the foreign executor.
+    for _ in range(6):
+        dst.run_frame()
+        ref.run_frame()
+    for (h, r), k in zip(zip(handles, r_handles), seeds):
+        d = moved[(h.group, h.slot)]
+        assert dst.groups[d.group].slots[d.slot].frame == 18
+        assert slot_cs(dst.groups[d.group], d.slot) == slot_cs(
+            ref.groups[r.group], r.slot
+        )
+
+
 def test_checkpointer_guards(tmp_path):
     server = make_server(checkpoint_dir=str(tmp_path), checkpoint_interval=4)
     server.add_match(make_synctest(), inputs_for(1))

@@ -113,6 +113,28 @@ def check_row(row: dict, base: Optional[dict],
         out.update(status="FAIL",
                    detail="serve row lost its device-time attribution verdict")
         return out
+    if metric.startswith("fleet_migrate_"):
+        # The fleet entry IS its robustness gates: a row that lost a
+        # match, compiled during churn, or dropped its stall/recovery
+        # percentiles is a regression regardless of the latency.
+        if row.get("matches_lost") != 0:
+            out.update(status="FAIL",
+                       detail=f"fleet row lost {row.get('matches_lost')!r} "
+                              "matches (gate: 0)")
+            return out
+        if row.get("churn_recompiles") != 0:
+            out.update(status="FAIL",
+                       detail="fleet churn compiled "
+                              f"{row.get('churn_recompiles')!r}x (gate: 0)")
+            return out
+        for col in ("migration_stall_p50_frames",
+                    "migration_stall_p99_frames",
+                    "recovery_p50_frames_server_loss",
+                    "recovery_p99_frames_server_loss"):
+            if not isinstance(row.get(col), (int, float)):
+                out.update(status="FAIL",
+                           detail=f"fleet row lost its {col} column")
+                return out
     if base is None:
         out.update(status="skipped", detail="no committed baseline row")
         return out
