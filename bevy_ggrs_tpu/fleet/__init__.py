@@ -23,6 +23,13 @@ players noticing, and what happens when a whole server disappears.
 
 docs/serving.md "Fleet tier" covers the policy math; docs/chaos.md lists
 the fleet fault model (BalancerPartition / MigrateMatch / ServerLoss).
+
+``fleet.traffic`` is the front door's load side: :class:`TrafficPlan`
+(seeded, replayable open-loop arrival schedules — Poisson match
+arrivals, spectator subscribes, abandons) and :class:`Matchmaker`
+(routes due arrivals through ``place_match`` with per-arrival
+:class:`~bevy_ggrs_tpu.serve.admission.AdmissionTrace` carried end to
+end). docs/serving.md "Front door" covers the model.
 """
 
 from bevy_ggrs_tpu.fleet.balancer import (
@@ -31,5 +38,22 @@ from bevy_ggrs_tpu.fleet.balancer import (
     Migration,
     Placement,
 )
+from bevy_ggrs_tpu.fleet.traffic import (
+    MatchAbandon,
+    MatchArrival,
+    Matchmaker,
+    SpectatorSubscribe,
+    TrafficPlan,
+)
 
-__all__ = ["FleetBalancer", "FleetMember", "Migration", "Placement"]
+__all__ = [
+    "FleetBalancer",
+    "FleetMember",
+    "MatchAbandon",
+    "MatchArrival",
+    "Matchmaker",
+    "Migration",
+    "Placement",
+    "SpectatorSubscribe",
+    "TrafficPlan",
+]

@@ -173,6 +173,7 @@ class FleetBalancer:
         plan=None,
         metrics=None,
         tracer=None,
+        page_refusal_threshold: int = 1,
     ):
         import time as _time
 
@@ -189,6 +190,12 @@ class FleetBalancer:
         self.plan = plan
         self.metrics = metrics if metrics is not None else null_metrics
         self.tracer = tracer if tracer is not None else null_tracer
+        # Placement policy: a member whose last type-22 heartbeat carries
+        # >= this many SLO pages is REFUSED as a placement target while
+        # any calmer candidate exists (<=0 disables the refusal).
+        self.page_refusal_threshold = int(page_refusal_threshold)
+        self.placements_refused_paging = 0
+        self.placements_on_paging = 0
         self.members: Dict[int, FleetMember] = {}
         self.placements: Dict[int, Placement] = {}
         self._nonce = 0
@@ -295,8 +302,18 @@ class FleetBalancer:
             + hb.slots_active / total
         )
 
+    def _pages(self, m: FleetMember) -> int:
+        hb = m.info if m.info is not None else m.server.heartbeat()
+        return int(hb.pages)
+
     def place(self, exclude: Tuple[int, ...] = ()) -> FleetMember:
-        """The least-burning live member with a free slot."""
+        """The least-burning live member with a free slot. A member whose
+        SLO burn signal is currently paging (type-22 heartbeat ``pages``
+        at or above ``page_refusal_threshold``) is refused outright — an
+        arrival storm routes around it — unless EVERY candidate is
+        paging, in which case the least-burning one still admits (full
+        refusal would turn one bad minute into an outage) and the
+        concession is counted."""
         candidates = [
             m
             for m in self._alive()
@@ -305,6 +322,18 @@ class FleetBalancer:
         ]
         if not candidates:
             raise RuntimeError("fleet has no admittable server")
+        if self.page_refusal_threshold > 0:
+            calm = [
+                m for m in candidates
+                if self._pages(m) < self.page_refusal_threshold
+            ]
+            if calm and len(calm) < len(candidates):
+                self.placements_refused_paging += 1
+                self.metrics.count("fleet_placements_refused_paging")
+                candidates = calm
+            elif not calm:
+                self.placements_on_paging += 1
+                self.metrics.count("fleet_placements_on_paging")
         return min(candidates, key=lambda m: (self._score(m), m.server_id))
 
     def place_match(
@@ -317,19 +346,33 @@ class FleetBalancer:
         donor=None,
         publisher=None,
         server_id: Optional[int] = None,
+        trace=None,
+        queue: bool = False,
     ) -> Tuple[int, MatchHandle]:
         """Fleet-level admission: pick a server (or honor the pin), admit
-        at its least-loaded stagger group, book the placement."""
+        at its least-loaded stagger group, book the placement. With
+        ``queue=True`` the server-side admission goes through its admit
+        queue (:meth:`~bevy_ggrs_tpu.serve.server.MatchServer.
+        enqueue_match`) — the slot is booked now, the expensive warm
+        drains off the destination's frame-critical path. ``trace`` (an
+        :class:`~bevy_ggrs_tpu.serve.admission.AdmissionTrace`) gets the
+        place stage recorded here and the server stages downstream."""
+        if trace is not None:
+            trace.begin("place")
         member = (
             self.members[server_id]
             if server_id is not None
             else self.place()
         )
-        handle = member.server.add_match(
+        admit = member.server.enqueue_match if queue else member.server.add_match
+        if trace is not None:
+            trace.end("place")
+        handle = admit(
             session,
             local_inputs,
             initial_state=initial_state,
             spec_on=spec_on,
+            trace=trace,
         )
         self.placements[int(match_id)] = Placement(
             match_id=int(match_id),

@@ -28,7 +28,8 @@ from .prom import export_prometheus
 from .provenance import ProvenanceLog, SidecarSocket, flow_key
 from .recorder import FlightRecorder, FrameRecord
 from .report import build_report
-from .slo import SLOConfig, SlotSLO
+from .slo import SLOConfig, SlotSLO, WindowSLO
+from .timeseries import MetricWindow, P2Quantile, TimeSeries, null_timeseries
 from .trace import SpanTracer, null_tracer
 
 
@@ -42,11 +43,15 @@ __all__ = [
     "DesyncForensics",
     "FlightRecorder",
     "FrameRecord",
+    "MetricWindow",
+    "P2Quantile",
     "ProvenanceLog",
     "SLOConfig",
     "SidecarSocket",
     "SlotSLO",
     "SpanTracer",
+    "TimeSeries",
+    "WindowSLO",
     "build_report",
     "desync_report",
     "export_perfetto",
@@ -55,6 +60,7 @@ __all__ = [
     "follow",
     "frame_flows",
     "merge_traces",
+    "null_timeseries",
     "null_tracer",
     "profile_window",
 ]

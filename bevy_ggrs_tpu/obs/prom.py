@@ -73,6 +73,7 @@ def export_prometheus(
     recorder=None,
     namespace: str = "ggrs",
     path: Optional[str] = None,
+    timeseries=None,
 ) -> str:
     lines = []
     typed = set()  # one "# TYPE" per family across its label sets
@@ -98,6 +99,29 @@ def export_prometheus(
                 lines.append(f"{base}{qlabels} {_num(stats[key])}")
             lines.append(f"{base}_sum{labels} {_num(stats['mean'] * count)}")
             lines.append(f"{base}_count{labels} {_num(count)}")
+    if timeseries is not None:
+        # Online pipeline (obs/timeseries.py): whole-stream P² quantiles
+        # as a summary, plus the exact live-window percentiles as gauges
+        # ({window="..."}) — the capacity signal a scrape reads mid-run.
+        for name, snap in sorted(timeseries.snapshot().items()):
+            raw_base, labels = _split_labels(name)
+            base = f"{namespace}_ts_{raw_base}"
+            type_line(base, "summary")
+            for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+                qlabels = _merge(labels, f'quantile="{q}"')
+                lines.append(f"{base}{qlabels} {_num(snap[key])}")
+            lines.append(
+                f"{base}_sum{labels} {_num(snap['mean'] * snap['count'])}"
+            )
+            lines.append(f"{base}_count{labels} {_num(snap['count'])}")
+            type_line(f"{base}_window", "gauge")
+            for q, key in (
+                ("0.5", "window_p50"),
+                ("0.95", "window_p95"),
+                ("0.99", "window_p99"),
+            ):
+                qlabels = _merge(labels, f'quantile="{q}"')
+                lines.append(f"{base}_window{qlabels} {_num(snap[key])}")
     if recorder is not None:
         hist = recorder.rollback_histogram()
         base = f"{namespace}_rollback_depth"

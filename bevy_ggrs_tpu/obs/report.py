@@ -156,6 +156,29 @@ def _attribution_section(attribution: Dict[str, dict]) -> str:
     return _table(["bench"] + keys, rows)
 
 
+def _timeseries_section(timeseries) -> str:
+    snap = (
+        timeseries.snapshot()
+        if hasattr(timeseries, "snapshot") else dict(timeseries)
+    )
+    if not snap:
+        return "<p class='small'>no time-series samples</p>"
+    headers = [
+        "series", "count", "last", "mean", "p50", "p95", "p99",
+        "window p50", "window p99", "min", "max",
+    ]
+    rows = [
+        [
+            name, s.get("count", 0), s.get("last", 0.0), s.get("mean", 0.0),
+            s.get("p50", 0.0), s.get("p95", 0.0), s.get("p99", 0.0),
+            s.get("window_p50", 0.0), s.get("window_p99", 0.0),
+            s.get("min", 0.0), s.get("max", 0.0),
+        ]
+        for name, s in sorted(snap.items())
+    ]
+    return _table(headers, rows)
+
+
 def _metrics_section(metrics) -> str:
     summ = metrics.summary() if hasattr(metrics, "summary") else dict(metrics)
     if not summ:
@@ -176,12 +199,15 @@ def build_report(
     recorders: Optional[Dict[str, object]] = None,
     attribution: Optional[Dict[str, dict]] = None,
     metrics=None,
+    timeseries=None,
     notes: Optional[str] = None,
 ) -> str:
     """Render the report; write it to ``path`` when given. ``slo`` is a
     :class:`~bevy_ggrs_tpu.obs.slo.SlotSLO` or its ``snapshot()`` dict;
     ``tracers`` / ``recorders`` map component name -> object;
-    ``attribution`` maps bench name -> attribution row dict."""
+    ``attribution`` maps bench name -> attribution row dict;
+    ``timeseries`` is a :class:`~bevy_ggrs_tpu.obs.timeseries.TimeSeries`
+    or its ``snapshot()`` dict."""
     sections = []
     if notes:
         sections.append(f"<p>{_esc(notes)}</p>")
@@ -192,6 +218,11 @@ def build_report(
         sections.append(
             "<h2>Device-time attribution</h2>"
             + _attribution_section(attribution)
+        )
+    if timeseries is not None:
+        sections.append(
+            "<h2>Time series (live windows)</h2>"
+            + _timeseries_section(timeseries)
         )
     if tracers:
         sections.append("<h2>Span summaries</h2>" + _spans_section(tracers))

@@ -38,7 +38,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-from typing import Deque, Dict, Optional
+from typing import Deque, Dict, Optional, Tuple
 
 from ..utils.metrics import null_metrics
 
@@ -201,5 +201,107 @@ class SlotSLO:
                     "objectives": self.burn_rates(slot),
                 }
                 for slot in sorted(self._slots)
+            },
+        }
+
+
+class WindowSLO:
+    """Server-scope SLO objectives evaluated over the online time-series
+    pipeline (:class:`~bevy_ggrs_tpu.obs.timeseries.TimeSeries`) instead
+    of per-slot tick booleans — how the SLO engine consumes latency
+    series that have no per-tick producer (admission latency, frame
+    wall time).
+
+    Each objective names a series and a threshold: a sample above the
+    threshold is a bad sample. Burn over the short window (the tail of
+    the ring) and the long window (the whole ring) reduces with the same
+    multi-window fast/slow rules as :class:`SlotSLO`, so the front-door
+    knee detector and the fleet balancer read one vocabulary of levels
+    everywhere."""
+
+    def __init__(
+        self,
+        timeseries,
+        objectives: Dict[str, Tuple[str, float, float]],
+        config: Optional[SLOConfig] = None,
+        metrics=null_metrics,
+    ):
+        """``objectives``: name -> (series_name, threshold, objective) —
+        e.g. ``{"admission": ("admission_ms", 8.0, 0.99)}`` reads "99% of
+        admissions complete within 8 ms"."""
+        self.timeseries = timeseries
+        self.objectives = dict(objectives)
+        self.config = config or SLOConfig()
+        self.metrics = metrics
+        self._levels: Dict[str, str] = {}
+
+    def burn_rates(self, name: str) -> Dict[str, float]:
+        series_name, threshold, objective = self.objectives[name]
+        w = self.timeseries.window_for(series_name)
+        budget = max(1.0 - float(objective), 1e-9)
+        if w is None:
+            return {
+                "short_n": 0, "short_bad": 0.0, "short_burn": 0.0,
+                "long_n": 0, "long_bad": 0.0, "long_burn": 0.0,
+            }
+        vals = w.window_values()
+        short = vals[-self.config.short_window:]
+        stats: Dict[str, float] = {}
+        for label, window in (("short", short), ("long", vals)):
+            n = len(window)
+            frac = (
+                sum(1 for v in window if v > threshold) / n if n else 0.0
+            )
+            stats[f"{label}_n"] = n
+            stats[f"{label}_bad"] = frac
+            stats[f"{label}_burn"] = frac / budget
+        return stats
+
+    def level(self, name: str) -> str:
+        cfg = self.config
+        stats = self.burn_rates(name)
+        if stats["short_n"] < cfg.min_samples:
+            return LEVEL_OK
+        if (
+            stats["short_burn"] >= cfg.fast_burn
+            and stats["long_burn"] >= cfg.fast_burn
+        ):
+            return LEVEL_PAGE
+        if stats["long_burn"] >= cfg.slow_burn:
+            return LEVEL_WARN
+        return LEVEL_OK
+
+    def export(self) -> Dict[str, str]:
+        """Levels for every objective, pushed through the labeled metrics
+        path (transition counters, like :meth:`SlotSLO.export`)."""
+        levels: Dict[str, str] = {}
+        for name in sorted(self.objectives):
+            stats = self.burn_rates(name)
+            self.metrics.observe(
+                "slo_burn_short", stats["short_burn"],
+                labels={"objective": name},
+            )
+            lvl = self.level(name)
+            levels[name] = lvl
+            if self._levels.get(name) != lvl:
+                self._levels[name] = lvl
+                self.metrics.count(
+                    "slo_level_transitions", 1,
+                    labels={"objective": name, "to": lvl},
+                )
+        return levels
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "config": dataclasses.asdict(self.config),
+            "objectives": {
+                name: {
+                    "series": self.objectives[name][0],
+                    "threshold": self.objectives[name][1],
+                    "objective": self.objectives[name][2],
+                    "level": self.level(name),
+                    "burn": self.burn_rates(name),
+                }
+                for name in sorted(self.objectives)
             },
         }

@@ -12,6 +12,11 @@ typed :class:`SlotFault`, the per-slot :class:`SlotHealthFSM`, singleton
 (docs/serving.md "Failure domains").
 """
 
+from bevy_ggrs_tpu.serve.admission import (
+    STAGES as ADMISSION_STAGES,
+    AdmissionTrace,
+    admission_key,
+)
 from bevy_ggrs_tpu.serve.batch import BatchedSessionCore, BatchedTickExecutor
 from bevy_ggrs_tpu.serve.faults import (
     RecoveryLane,
@@ -27,7 +32,10 @@ from bevy_ggrs_tpu.serve.faults import (
 from bevy_ggrs_tpu.serve.server import MatchHandle, MatchServer
 
 __all__ = [
+    "ADMISSION_STAGES",
+    "AdmissionTrace",
     "BatchedSessionCore",
+    "admission_key",
     "BatchedTickExecutor",
     "MatchHandle",
     "MatchServer",

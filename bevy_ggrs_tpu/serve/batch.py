@@ -53,6 +53,7 @@ logic to drift.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -233,12 +234,23 @@ class BatchedSessionCore:
         tracer=None,
         executor: Optional[BatchedTickExecutor] = None,
         report_checksums: bool = True,
+        timeseries=None,
     ):
+        from bevy_ggrs_tpu.obs.timeseries import null_timeseries
         from bevy_ggrs_tpu.obs.trace import null_tracer
         from bevy_ggrs_tpu.utils.metrics import null_metrics
 
         self.metrics = metrics if metrics is not None else null_metrics
         self.tracer = tracer if tracer is not None else null_tracer
+        self.timeseries = (
+            timeseries if timeseries is not None else null_timeseries
+        )
+        # Host-work decomposition arms only when someone is listening —
+        # the clock reads would otherwise tax the per-slot loop for
+        # nothing (the telemetry-off determinism guard stays exact).
+        self._measure_host = (
+            self.metrics is not null_metrics or self.timeseries.enabled
+        )
         self.schedule = schedule
         self.num_players = int(num_players)
         self.input_spec = input_spec
@@ -315,6 +327,11 @@ class BatchedSessionCore:
         self.rollbacks_total = 0
         self.rollback_frames_total = 0
         self.rollback_frames_recovered_total = 0
+        # Last dispatch's measured host-work split (docs/serving.md
+        # "Front door"): the known per-slot Python-loop budget, decomposed
+        # so the ROADMAP's native-argument-assembly item has a baseline.
+        self.last_branch_build_ms = 0.0
+        self.last_arg_assembly_ms = 0.0
 
     # -- lifecycle ------------------------------------------------------
 
@@ -583,6 +600,9 @@ class BatchedSessionCore:
         post: Dict[int, tuple] = {}
         reports: List[tuple] = []
 
+        measure = self._measure_host
+        t_loop = time.perf_counter() if measure else 0.0
+        bb_ms = 0.0
         for s in self.slots:
             i = s.index
             if i not in batch:
@@ -653,7 +673,12 @@ class BatchedSessionCore:
                 s.spec_on and anchor <= end and anchor > end - self.ring_depth
             )
             if spec_active:
-                bb = self._build_branches(s, anchor, end, session)
+                if measure:
+                    t_bb = time.perf_counter()
+                    bb = self._build_branches(s, anchor, end, session)
+                    bb_ms += (time.perf_counter() - t_bb) * 1000.0
+                else:
+                    bb = self._build_branches(s, anchor, end, session)
                 spec_anchor, from_live = anchor, (anchor == end)
             else:
                 bb = self._zero_bb
@@ -690,6 +715,18 @@ class BatchedSessionCore:
                 from_live, load_frame, n_commit, n_steps, burst_start,
                 n_tail, session,
             )
+
+        if measure:
+            # Everything in the loop that is not the branch build is
+            # argument assembly (log writes, match, per-slot array fills).
+            loop_ms = (time.perf_counter() - t_loop) * 1000.0
+            arg_ms = max(0.0, loop_ms - bb_ms)
+            self.last_branch_build_ms = bb_ms
+            self.last_arg_assembly_ms = arg_ms
+            self.metrics.observe("serve_branch_build", bb_ms)
+            self.metrics.observe("serve_arg_assembly", arg_ms)
+            self.timeseries.observe("serve_branch_build_ms", bb_ms)
+            self.timeseries.observe("serve_arg_assembly_ms", arg_ms)
 
         self.device_dispatches_total += 1
         with self.metrics.timer("serve_dispatch"):

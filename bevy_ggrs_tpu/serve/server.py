@@ -128,8 +128,12 @@ class MatchServer:
         fleet_socket=None,
         fleet_addr=None,
         heartbeat_interval: int = 8,
+        timeseries=None,
+        admit_budget: int = 4,
+        admission_slo_ms: Optional[float] = None,
     ):
-        from bevy_ggrs_tpu.obs.slo import SlotSLO
+        from bevy_ggrs_tpu.obs.slo import SlotSLO, WindowSLO
+        from bevy_ggrs_tpu.obs.timeseries import null_timeseries
         from bevy_ggrs_tpu.obs.trace import null_tracer
         from bevy_ggrs_tpu.utils.metrics import null_metrics
         from bevy_ggrs_tpu.utils.xla_cache import (
@@ -141,6 +145,9 @@ class MatchServer:
         install_compile_listeners()
         self.metrics = metrics if metrics is not None else null_metrics
         self.tracer = tracer if tracer is not None else null_tracer
+        self.timeseries = (
+            timeseries if timeseries is not None else null_timeseries
+        )
         self.frame_ms = float(frame_ms)
         self._clock = clock
         # Watchdog: a session's host work (poll + inputs + advance) gets
@@ -169,6 +176,7 @@ class MatchServer:
                 spec_frames=spec_frames, branch_values=branch_values,
                 metrics=self.metrics, tracer=self.tracer,
                 executor=self._exec, report_checksums=report_checksums,
+                timeseries=self.timeseries,
             )
             for _ in range(G)
         ]
@@ -211,6 +219,35 @@ class MatchServer:
         self.slo_export_interval = max(1, int(slo_export_interval))
         self.slo_levels: Dict[int, str] = {}
         self.trace_dir = trace_dir
+        # Admission queue: enqueue_match reserves the slot immediately and
+        # returns the handle, but the expensive part of a join (session
+        # warm, initial-state build, device admit) drains AFTER every
+        # group has dispatched — a slow join costs the joiner latency,
+        # never a sibling group its deadline. admit_budget bounds drains
+        # per frame so an arrival storm cannot own the inter-frame gap.
+        self.admit_budget = max(1, int(admit_budget))
+        self._admit_queue: List[tuple] = []
+        self._pending_first: Dict[MatchHandle, object] = {}
+        self.admissions_completed = 0
+        # Server-scope SLOs over the online time-series windows (the
+        # signals the front-door knee detector and the balancer read).
+        self.admission_slo_ms = (
+            2.0 * self.frame_ms
+            if admission_slo_ms is None
+            else float(admission_slo_ms)
+        )
+        self.window_slo = WindowSLO(
+            self.timeseries,
+            {
+                "admission": (
+                    "admission_ms", self.admission_slo_ms, 0.99,
+                ),
+                "frame_deadline": ("frame_ms", self.frame_ms, 0.99),
+            },
+            config=slo_config,
+            metrics=self.metrics,
+        )
+        self.front_door_levels: Dict[str, str] = {}
         # Fleet membership: with a socket + balancer address configured,
         # the server emits a FleetHeartbeat every heartbeat_interval served
         # frames — the balancer's liveness signal (missed beats past its
@@ -367,17 +404,7 @@ class MatchServer:
         self._matches[handle] = m
         return m
 
-    def add_match(
-        self,
-        session,
-        local_inputs: Optional[Callable[[int, int], object]] = None,
-        initial_state=None,
-        spec_on: bool = True,
-    ) -> MatchHandle:
-        """Admit a match: its session + a ``local_inputs(frame, handle) ->
-        bits`` callback feeding the session's local handles each frame.
-        Slots balance across stagger groups (least-loaded first); slots
-        reserved for recovering matches are never handed out."""
+    def _pick_slot(self) -> MatchHandle:
         group = max(
             range(len(self.groups)),
             key=lambda g: (len(self._free_unreserved(g)), -g),
@@ -385,21 +412,104 @@ class MatchServer:
         free = self._free_unreserved(group)
         if not free:
             raise RuntimeError("server at capacity")
-        core = self.groups[group]
-        slot = core.admit(
-            initial_state=initial_state, slot=free[0], spec_on=spec_on
+        return MatchHandle(group, free[0])
+
+    def add_match(
+        self,
+        session,
+        local_inputs: Optional[Callable[[int, int], object]] = None,
+        initial_state=None,
+        spec_on: bool = True,
+        trace=None,
+    ) -> MatchHandle:
+        """Admit a match synchronously: its session + a ``local_inputs
+        (frame, handle) -> bits`` callback feeding the session's local
+        handles each frame. Slots balance across stagger groups
+        (least-loaded first); slots reserved for recovering matches are
+        never handed out. ``trace`` (an :class:`~bevy_ggrs_tpu.serve.
+        admission.AdmissionTrace`) gets the slot_warm/admit stages and
+        first-frame completion recorded against it."""
+        handle = self._pick_slot()
+        self._admit_at(
+            handle, session, local_inputs, initial_state, spec_on, trace
         )
-        handle = MatchHandle(group, slot)
-        self._register(handle, session, local_inputs, spec_on)
         return handle
 
+    def enqueue_match(
+        self,
+        session,
+        local_inputs: Optional[Callable[[int, int], object]] = None,
+        initial_state=None,
+        spec_on: bool = True,
+        trace=None,
+    ) -> MatchHandle:
+        """Admit a match OFF the frame-critical path: the slot is
+        reserved and the handle returned now, but session warm +
+        initial-state build + device admit run at the end of a
+        :meth:`run_frame` (after every group dispatched), bounded by
+        ``admit_budget`` per frame. ``initial_state`` may be a zero-arg
+        callable — the lazy-build hook that keeps an expensive world
+        construction off sibling groups' deadlines."""
+        handle = self._pick_slot()
+        self._reserved[handle.group].add(handle.slot)
+        if trace is not None:
+            trace.begin("first_frame")
+        self._admit_queue.append(
+            (handle, session, local_inputs, initial_state, spec_on, trace)
+        )
+        self.metrics.count("admissions_queued")
+        return handle
+
+    def _admit_at(
+        self, handle, session, local_inputs, initial_state, spec_on, trace
+    ) -> None:
+        """The expensive half of admission, shared by the synchronous
+        path and the queue drain: build the slot's initial state
+        (resolving a lazy callable), device-admit, register the match."""
+        core = self.groups[handle.group]
+        if trace is not None:
+            trace.begin("slot_warm")
+        if callable(initial_state):
+            initial_state = initial_state()
+        m = None
+        try:
+            if trace is not None:
+                trace.end("slot_warm")
+                trace.begin("admit")
+            core.admit(
+                initial_state=initial_state,
+                slot=handle.slot,
+                spec_on=spec_on,
+            )
+            m = self._register(handle, session, local_inputs, spec_on)
+        finally:
+            if trace is not None and trace.is_open("admit"):
+                trace.end("admit")
+            if m is not None:
+                # Pending even without a trace: admissions_completed and
+                # the admission_ms series count EVERY admission.
+                self._pending_first[handle] = trace
+                if trace is not None and not trace.is_open("first_frame"):
+                    trace.begin("first_frame")
+
     def retire_match(self, handle: MatchHandle) -> None:
+        # A match retired while still in the admit queue (an abandon that
+        # beat its own admission) just releases its reservation.
+        for i, pending in enumerate(self._admit_queue):
+            if pending[0] == handle:
+                del self._admit_queue[i]
+                self._reserved[handle.group].discard(handle.slot)
+                trace = pending[5]
+                if trace is not None:
+                    trace.finish()
+                return
         lane = self._lanes.pop(handle, None)
         if lane is not None:
             self._reserved[handle.group].discard(handle.slot)
         else:
             self.groups[handle.group].retire(handle.slot)
         self._matches.pop(handle, None)
+        self._pending_first.pop(handle, None)
 
     def suspend_match(self, handle: MatchHandle) -> SlotTicket:
         """Voluntary drain: extract the match's full trajectory state as a
@@ -485,6 +595,24 @@ class MatchServer:
             local_inputs=local_inputs, fault_frame=None,
         )
         return handle
+
+    def _finish_admission(self, handle: MatchHandle, trace) -> None:
+        """The arrival's terminal stage: its slot just rode a successful
+        group dispatch. Closes the trace and feeds the admission series
+        the window SLO + knee detector read. ``trace`` may be None
+        (untraced admissions still count)."""
+        self.admissions_completed += 1
+        self.metrics.count("admissions_completed")
+        if trace is None:
+            return
+        if trace.is_open("first_frame"):
+            trace.end("first_frame")
+        trace.finish(server_id=self.server_id, handle=handle)
+        total = trace.total_ms
+        self.metrics.observe("admission_ms", total)
+        self.timeseries.observe("admission_ms", total)
+        for stage, ms in trace.durations.items():
+            self.timeseries.observe(f"admission_{stage}_ms", ms)
 
     # -- fault containment ----------------------------------------------
 
@@ -635,6 +763,7 @@ class MatchServer:
         rest. Recovery lanes step after the groups, readmitting or
         evicting as they resolve."""
         t0 = self._clock()
+        t_wall = time.perf_counter()
         worst_jitter = 0.0
         by_group: Dict[int, Dict[int, Tuple[MatchHandle, _Match]]] = {}
         for handle, m in self._matches.items():
@@ -740,6 +869,15 @@ class MatchServer:
                             handle, self._matches[handle], f.reason,
                             cause=f, pending=(requests, session),
                         )
+                # Any slot that just rode its first successful dispatch
+                # completes its admission trace: first_frame_served.
+                if work and self._pending_first:
+                    for slot in work:
+                        h = MatchHandle(g, slot)
+                        if h in self._pending_first:
+                            self._finish_admission(
+                                h, self._pending_first.pop(h)
+                            )
         # Recovery lanes: off the hot path, after every group dispatched.
         now = self._clock()
         # Group head frames — a lane's recovery debt is how far it trails
@@ -779,15 +917,43 @@ class MatchServer:
                 or lane.errors > self.lane_error_limit
             ):
                 self._evict(handle, lane)
+        # Admission-queue drain: AFTER every group dispatched, so a slow
+        # join (lazy world build, big supervisor warm) costs the joiner
+        # latency, never a sibling group its deadline. Budget-bounded.
+        for _ in range(min(self.admit_budget, len(self._admit_queue))):
+            handle, session, local_inputs, initial_state, spec_on, trace = (
+                self._admit_queue.pop(0)
+            )
+            self._reserved[handle.group].discard(handle.slot)
+            with self.tracer.span(
+                "admit_drain", group=handle.group, slot=handle.slot
+            ):
+                self._admit_at(
+                    handle, session, local_inputs, initial_state, spec_on,
+                    trace,
+                )
         self.last_stagger_jitter_ms = worst_jitter
         self.frames_served += 1
         self.metrics.count("frames_served")
+        if self.timeseries.enabled:
+            # perf_counter, not self._clock: frame cost is real host work
+            # even when the serving loop runs on a virtual clock.
+            self.timeseries.observe(
+                "frame_ms", (time.perf_counter() - t_wall) * 1000.0
+            )
+            self.timeseries.observe("stagger_jitter_ms", worst_jitter)
+            self.timeseries.observe("slots_active", self.slots_active)
+            self.timeseries.observe(
+                "admit_queue_depth", len(self._admit_queue)
+            )
         if self.frames_served % self.slo_export_interval == 0:
             self.slo_levels = self.slo.export()
             for handle, m in self._matches.items():
                 lvl = self.slo_levels.get(self._flat_slot(handle))
                 if lvl is not None:
                     m.fsm.slo_signal(lvl, frame=self.frames_served)
+            if self.timeseries.enabled:
+                self.front_door_levels = self.window_slo.export()
         if (
             self.fleet_socket is not None
             and self.fleet_addr is not None
@@ -829,12 +995,23 @@ class MatchServer:
             self.tracer.export_perfetto(p)
             out["trace"] = p
         p = _os.path.join(directory, f"{prefix}_metrics.prom")
-        export_prometheus(self.metrics, path=p)
+        export_prometheus(
+            self.metrics,
+            path=p,
+            timeseries=(
+                self.timeseries if self.timeseries.enabled else None
+            ),
+        )
         out["metrics"] = p
         p = _os.path.join(directory, f"{prefix}_slo.json")
         with open(p, "w") as f:
             _json.dump(self.slo.snapshot(), f, indent=2)
         out["slo"] = p
+        if self.timeseries.enabled:
+            p = _os.path.join(directory, f"{prefix}_front_door_slo.json")
+            with open(p, "w") as f:
+                _json.dump(self.window_slo.snapshot(), f, indent=2)
+            out["front_door_slo"] = p
         p = _os.path.join(directory, f"{prefix}_report.html")
         build_report(
             p,
@@ -842,6 +1019,9 @@ class MatchServer:
             slo=self.slo,
             tracers={prefix: self.tracer},
             metrics=self.metrics,
+            timeseries=(
+                self.timeseries if self.timeseries.enabled else None
+            ),
             notes=(
                 f"frames_served={self.frames_served} "
                 f"faults={self.faults_total} "

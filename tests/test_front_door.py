@@ -1,0 +1,209 @@
+"""Front-door admission contracts: queued admission off the
+frame-critical path, storm routing around paging servers, and the
+window-SLO levels the knee detector reads.
+
+- A slow slot warm (lazy world build) riding the admit queue costs the
+  JOINER latency — sibling stagger groups keep their dispatch cadence
+  (``stagger_jitter_ms`` stays flat through the drain frame).
+- The admit queue is budget-bounded: a burst of enqueues drains a few
+  per frame, reservations keep the slots booked meanwhile, and a match
+  retired while still queued never touches a core.
+- An arrival storm routes around a paging server
+  (``page_refusal_threshold``) — and when EVERY server is paging, the
+  least-burning one still admits (refusal must not become an outage).
+- ``MatchServer.window_slo`` turns sustained admission/frame-deadline
+  violations into the ok/warn/page vocabulary the ladder bench gates on.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu.fleet import FleetBalancer
+from bevy_ggrs_tpu.obs import TimeSeries
+from bevy_ggrs_tpu.serve import ADMISSION_STAGES, AdmissionTrace, MatchServer
+from bevy_ggrs_tpu.session import protocol as proto
+from bevy_ggrs_tpu.transport.loopback import LoopbackNetwork
+from bevy_ggrs_tpu.utils.metrics import Metrics
+from tests.test_serve_faults import inputs_for, make_server, make_synctest
+
+FPS_DT = 1.0 / 60.0
+
+
+# ---------------------------------------------------------------------------
+# Queued admission: slow warms never bill a sibling group
+# ---------------------------------------------------------------------------
+
+
+def test_slow_warm_on_admit_queue_keeps_sibling_jitter_flat():
+    """A 30 ms lazy initial-state build rides the queue drain (after the
+    last group dispatch), so no frame's intra-frame stagger cadence moves
+    — the warm's cost lands on the joiner's slot_warm stage instead."""
+    srv = make_server(metrics=Metrics())  # real clock: jitter is real
+    srv.add_match(make_synctest(), inputs_for(1))  # group 0 resident
+    srv.add_match(make_synctest(), inputs_for(2))  # group 1 resident
+    for _ in range(20):
+        srv.run_frame()
+    baseline = srv.last_stagger_jitter_ms
+
+    warm_ms = 30.0
+
+    def slow_state():
+        time.sleep(warm_ms / 1000.0)
+        return None
+
+    trace = AdmissionTrace(77)
+    srv.enqueue_match(
+        make_synctest(), inputs_for(3), initial_state=slow_state,
+        trace=trace,
+    )
+    worst = 0.0
+    for _ in range(10):
+        srv.run_frame()
+        worst = max(worst, srv.last_stagger_jitter_ms)
+    # The warm demonstrably ran (and was expensive)...
+    assert trace.durations["slot_warm"] >= warm_ms * 0.9
+    assert trace.t_done is not None  # server-side stages closed out
+    assert {"slot_warm", "admit", "first_frame"} <= set(trace.durations)
+    # ...but no group's dispatch slipped anywhere near the warm's cost.
+    assert worst < baseline + warm_ms / 2, (
+        f"jitter {worst:.2f} ms vs baseline {baseline:.2f} ms — the warm "
+        "leaked onto the frame-critical path"
+    )
+
+
+def test_admit_queue_is_budget_bounded_with_reservations():
+    srv = make_server(metrics=Metrics(), capacity=8, admit_budget=2)
+    handles = [
+        srv.enqueue_match(make_synctest(), inputs_for(k)) for k in range(6)
+    ]
+    assert len(set(handles)) == 6  # reservations prevent slot collisions
+    assert srv.slots_active == 0
+    assert srv.slots_free == 2  # 6 of 8 booked
+    assert srv.metrics.counters["admissions_queued"] == 6
+    served = []
+    for _ in range(3):
+        srv.run_frame()
+        served.append(srv.slots_active)
+    assert served == [2, 4, 6]  # budget-paced drain
+    assert srv.admissions_completed >= 2  # first drains already served
+    for _ in range(5):
+        srv.run_frame()
+    assert srv.admissions_completed == 6
+
+
+def test_retire_while_still_queued_releases_reservation():
+    srv = make_server(metrics=Metrics(), capacity=2, admit_budget=1)
+    trace = AdmissionTrace(5)
+    h1 = srv.enqueue_match(make_synctest(), inputs_for(1), trace=trace)
+    h2 = srv.enqueue_match(make_synctest(), inputs_for(2))
+    srv.retire_match(h1)
+    assert trace.t_done is not None  # trace closed, not completed
+    assert not trace.complete
+    for _ in range(3):
+        srv.run_frame()
+    assert srv.slots_active == 1  # only h2 admitted
+    assert srv.slots_free == 1  # h1's reservation released
+    # The freed slot is reusable immediately.
+    h3 = srv.add_match(make_synctest(), inputs_for(3))
+    assert srv.slots_active == 2
+    assert h3 != h2
+
+
+def test_queued_admission_trace_measures_queue_wait_in_first_frame():
+    """first_frame opens at enqueue, so the queued wait is inside it —
+    the stage the saturation ladder watches grow as the queue backs up."""
+    net = LoopbackNetwork()
+    srv = make_server(
+        metrics=Metrics(), clock=lambda: net.now, admit_budget=1,
+        capacity=4,
+    )
+    traces = []
+    for k in range(3):
+        t = AdmissionTrace(k, clock=lambda: net.now)
+        srv.enqueue_match(make_synctest(), inputs_for(k), trace=t)
+        traces.append(t)
+    for _ in range(6):
+        net.advance(FPS_DT)
+        srv.run_frame()
+    assert all(t.t_done is not None for t in traces)
+    waits = [t.durations["first_frame"] for t in traces]
+    # Budget 1/frame: each successive admission waits ~one frame longer.
+    assert waits[0] < waits[1] < waits[2]
+    assert waits[2] - waits[0] >= 1.5 * FPS_DT * 1000
+
+
+# ---------------------------------------------------------------------------
+# Storm routing: paging servers repel placements
+# ---------------------------------------------------------------------------
+
+
+def hb(sid, pages, active=0, free=4, quarantined=0):
+    return proto.FleetHeartbeat(sid, 0, active, free, quarantined, pages)
+
+
+def test_arrival_storm_routes_around_paging_server():
+    bal = FleetBalancer(metrics=Metrics())
+    a = bal.register(0, make_server(server_id=0))
+    b = bal.register(1, make_server(server_id=1))
+    a.info = hb(0, pages=1, active=0, free=4)
+    b.info = hb(1, pages=0, active=3, free=1)  # busier but calm
+    for m in range(3):
+        sid, _ = bal.place_match(m, make_synctest(), inputs_for(m))
+        assert sid == 1  # storm lands on the calm server every time
+        b.info = hb(1, pages=0, active=3 + m + 1, free=1)
+    assert bal.placements_refused_paging == 3
+    assert bal.metrics.counters["fleet_placements_refused_paging"] == 3
+    assert bal.placements_on_paging == 0
+
+
+def test_all_paging_fleet_still_admits_least_burning():
+    bal = FleetBalancer(metrics=Metrics())
+    a = bal.register(0, make_server(server_id=0))
+    b = bal.register(1, make_server(server_id=1))
+    a.info = hb(0, pages=3)
+    b.info = hb(1, pages=1)
+    sid, _ = bal.place_match(9, make_synctest(), inputs_for(9))
+    assert sid == 1  # least-burning paging server
+    assert bal.placements_on_paging == 1
+    assert bal.placements_refused_paging == 0
+
+
+def test_page_refusal_can_be_disabled():
+    bal = FleetBalancer(metrics=Metrics(), page_refusal_threshold=0)
+    a = bal.register(0, make_server(server_id=0))
+    b = bal.register(1, make_server(server_id=1))
+    # Pure score: one page (100) on a outweighs occupancy on b.
+    a.info = hb(0, pages=1, active=0, free=4)
+    b.info = hb(1, pages=0, active=3, free=1)
+    assert bal.place().server_id == 1
+    assert bal.placements_refused_paging == 0  # policy off: no refusals
+
+
+# ---------------------------------------------------------------------------
+# Front-door SLO levels on the live pipeline
+# ---------------------------------------------------------------------------
+
+
+def test_server_window_slo_pages_on_sustained_admission_burn():
+    srv = make_server(metrics=Metrics(), timeseries=TimeSeries())
+    assert srv.window_slo.level("admission") == "ok"  # cold start
+    for _ in range(128):
+        srv.timeseries.observe("admission_ms", srv.admission_slo_ms * 4)
+    assert srv.window_slo.level("admission") == "page"
+    levels = srv.window_slo.export()
+    assert levels == {"admission": "page", "frame_deadline": "ok"}
+
+
+def test_front_door_levels_update_on_slo_export_cadence():
+    srv = make_server(
+        metrics=Metrics(), timeseries=TimeSeries(), slo_export_interval=4,
+    )
+    srv.add_match(make_synctest(), inputs_for(1))
+    for _ in range(8):
+        srv.run_frame()
+    assert srv.front_door_levels.get("frame_deadline") in (
+        "ok", "warn", "page",
+    )
+    assert set(srv.front_door_levels) == {"admission", "frame_deadline"}

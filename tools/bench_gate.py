@@ -135,6 +135,29 @@ def check_row(row: dict, base: Optional[dict],
                 out.update(status="FAIL",
                            detail=f"fleet row lost its {col} column")
                 return out
+    if metric.startswith("front_door_"):
+        # The saturation-ladder row IS its health gates: a knee measured
+        # with slot faults, compiles during admission churn, or a lost
+        # decomposition column is a regression regardless of the p99.
+        if row.get("desyncs") != 0:
+            out.update(status="FAIL",
+                       detail=f"front-door row saw {row.get('desyncs')!r} "
+                              "slot faults during the ladder (gate: 0)")
+            return out
+        if row.get("churn_recompiles") != 0:
+            out.update(status="FAIL",
+                       detail="admission churn compiled "
+                              f"{row.get('churn_recompiles')!r}x (gate: 0)")
+            return out
+        for col in ("knee_admissions_per_sec", "admission_p50_ms",
+                    "admission_p99_ms", "stage_place_p99_ms",
+                    "stage_slot_warm_p99_ms", "stage_admit_p99_ms",
+                    "stage_first_frame_p99_ms", "branch_build_p99_ms",
+                    "arg_assembly_p99_ms"):
+            if not isinstance(row.get(col), (int, float)):
+                out.update(status="FAIL",
+                           detail=f"front-door row lost its {col} column")
+                return out
     if base is None:
         out.update(status="skipped", detail="no committed baseline row")
         return out
