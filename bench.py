@@ -875,6 +875,32 @@ def _live_common_columns(metrics, runner0, executed_ticks, tick_ms,
             host_dispatch_p50 <= HOST_DISPATCH_BUDGET_MS
         ),
         fused_dispatch_floor_ms=round(fused_floor, 3),
+        **_ledger_columns(getattr(runner0, "ledger", None)),
+    )
+
+
+def _ledger_columns(ledger) -> dict:
+    """Branch-economics columns from a speculation ledger (obs/ledger.py).
+    Present on every spec-capable row — bench_gate schema-checks them and
+    fails a ``*_spec_on*`` row whose full-hit rate is zero (a silently
+    dead speculation path otherwise passes the bench)."""
+    if ledger is None or not getattr(ledger, "enabled", False):
+        return dict(
+            spec_full_hit_rate=0.0,
+            spec_hit_rank_p50=0,
+            spec_hit_rank_p99=0,
+            spec_waste_ratio=0.0,
+            blame_top_player_share=0.0,
+        )
+    s = ledger.summary()
+    return dict(
+        spec_full_hit_rate=round(float(s["spec_full_hit_rate"]), 4),
+        spec_hit_rank_p50=int(s["spec_hit_rank_p50"]),
+        spec_hit_rank_p99=int(s["spec_hit_rank_p99"]),
+        spec_waste_ratio=round(float(s["spec_waste_ratio"]), 4),
+        blame_top_player_share=round(
+            float(s["blame_top_player_share"]), 4
+        ),
     )
 
 
@@ -972,12 +998,14 @@ def _live_session_case(model: str, speculate: bool, transport: str) -> dict:
             tracer=tracer if me == 0 else None,
         )
         if me == 0 and speculate:
+            from bevy_ggrs_tpu.obs.ledger import SpeculationLedger
+
             runner = SpeculativeRollbackRunner(
                 cfg["schedule"](), cfg["world"](players),
                 max_prediction=max_prediction, num_players=players,
                 input_spec=cfg["input_spec"],
                 num_branches=cfg["branches"], metrics=metrics,
-                tracer=tracer,
+                tracer=tracer, ledger=SpeculationLedger(),
             )
         else:
             runner = RollbackRunner(
@@ -1158,12 +1186,14 @@ def _live_8p_spectator_case(speculate: bool) -> dict:
             builder.add_player(PlayerType.spectator(("spec", 0)), P)
         session = builder.start_p2p_session(sock, clock=lambda: net.now)
         if me == 0 and speculate:
+            from bevy_ggrs_tpu.obs.ledger import SpeculationLedger
+
             runner = SpeculativeRollbackRunner(
                 box_game.make_schedule(), box_game.make_world(P).commit(),
                 max_prediction=MAXPRED, num_players=P,
                 input_spec=box_game.INPUT_SPEC,
                 num_branches=BRANCHES, spec_frames=MAXPRED,
-                metrics=metrics,
+                metrics=metrics, ledger=SpeculationLedger(),
             )
         else:
             runner = RollbackRunner(
@@ -1809,9 +1839,12 @@ def _serve_batched_case(model: str, S: int) -> dict:
 
         tracer = SpanTracer(pid=0, process_name=f"serve_{model}_S{S}")
 
+    from bevy_ggrs_tpu.obs.ledger import SpeculationLedger
+
+    ledger = SpeculationLedger()
     core = BatchedSessionCore(
         schedule, initial, MAXPRED, P, input_spec, num_slots=S,
-        num_branches=B, spec_frames=F,
+        num_branches=B, spec_frames=F, ledger=ledger,
         **({"tracer": tracer} if tracer is not None else {}),
     )
     core.warmup()
@@ -1931,6 +1964,7 @@ def _serve_batched_case(model: str, S: int) -> dict:
             title=f"serve_batched_{model}_S{S}",
             tracers={} if tracer is None else {"serve": tracer},
             attribution={f"serve_batched_{model}_S{S}": attribution},
+            ledger=ledger,
         )
 
     per_match = tick_p50 / S
@@ -1952,6 +1986,7 @@ def _serve_batched_case(model: str, S: int) -> dict:
         parity_slots_checked=len(sample),
         churn_recompiles=int(churn_recompiles),
         cache_size_stable=bool(core._exec.cache_size() == cache0),
+        **_ledger_columns(ledger),
         **attribution,
         notes=(
             "spec-ON, depth-2 rollback every 6th tick on every match; "

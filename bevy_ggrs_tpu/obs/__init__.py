@@ -23,6 +23,12 @@ Quick start::
 
 from .attribution import AttributionProbe, profile_window
 from .forensics import DesyncForensics, desync_report
+from .ledger import (
+    SpeculationLedger,
+    blame_divergence,
+    null_ledger,
+    replay_baseline,
+)
 from .merge import follow, frame_flows, merge_traces
 from .prom import export_prometheus
 from .provenance import ProvenanceLog, SidecarSocket, flow_key
@@ -50,8 +56,10 @@ __all__ = [
     "SidecarSocket",
     "SlotSLO",
     "SpanTracer",
+    "SpeculationLedger",
     "TimeSeries",
     "WindowSLO",
+    "blame_divergence",
     "build_report",
     "desync_report",
     "export_perfetto",
@@ -60,7 +68,9 @@ __all__ = [
     "follow",
     "frame_flows",
     "merge_traces",
+    "null_ledger",
     "null_timeseries",
     "null_tracer",
     "profile_window",
+    "replay_baseline",
 ]

@@ -14,7 +14,10 @@ Mapping:
   (gauge, the sink's lifetime rate);
 - series    -> a summary: ``{quantile="0.5|0.95|0.99"}`` samples plus
   ``_count`` and ``_sum`` (reconstructed as mean*count);
-- recorder  -> ``{ns}_rollback_depth`` cumulative histogram buckets.
+- recorder  -> ``{ns}_rollback_depth`` cumulative histogram buckets;
+- ledger    -> ``{ns}_spec_*`` branch-economics samples (lifetime
+  counters, hit-rate/waste gauges, a hit-rank summary, and per-player
+  ``{ns}_spec_blame_share{player="p"}`` gauges).
 
 Labeled instruments (``Metrics.count(..., labels={"match_slot": s})``)
 arrive as ``name{k="v"}`` keys — the label block is split off, preserved
@@ -74,6 +77,7 @@ def export_prometheus(
     namespace: str = "ggrs",
     path: Optional[str] = None,
     timeseries=None,
+    ledger=None,
 ) -> str:
     lines = []
     typed = set()  # one "# TYPE" per family across its label sets
@@ -122,6 +126,36 @@ def export_prometheus(
             ):
                 qlabels = _merge(labels, f'quantile="{q}"')
                 lines.append(f"{base}_window{qlabels} {_num(snap[key])}")
+    if ledger is not None:
+        # Speculation ledger (obs/ledger.py): branch economics as gauges.
+        # Counts are also counters in spirit, but the ledger is bounded
+        # (deque) while the *_total attrs are lifetime — export the
+        # lifetime attrs so scrapes never see a value go backwards.
+        s = ledger.summary()
+        base = f"{namespace}_spec"
+        for key, suffix, kind in (
+            ("rollbacks", "rollbacks_total", "counter"),
+            ("spec_full", "full_total", "counter"),
+            ("spec_partial", "partial_total", "counter"),
+            ("spec_miss", "miss_total", "counter"),
+            ("spec_unmatched", "unmatched_total", "counter"),
+            ("spec_frames_dispatched", "frames_dispatched_total", "counter"),
+            ("frames_recovered_total", "frames_recovered_total", "counter"),
+            ("spec_full_hit_rate", "full_hit_rate", "gauge"),
+            ("spec_waste_ratio", "waste_ratio", "gauge"),
+            ("blame_top_player_share", "blame_top_player_share", "gauge"),
+        ):
+            name = f"{base}_{suffix}"
+            type_line(name, kind)
+            lines.append(f"{name} {_num(s[key])}")
+        type_line(f"{base}_hit_rank", "summary")
+        for q, key in (("0.5", "spec_hit_rank_p50"), ("0.99", "spec_hit_rank_p99")):
+            lines.append(f'{base}_hit_rank{{quantile="{q}"}} {_num(s[key])}')
+        type_line(f"{base}_blame_share", "gauge")
+        for player, share in sorted(ledger.blame_shares().items()):
+            lines.append(
+                f'{base}_blame_share{{player="{player}"}} {_num(share)}'
+            )
     if recorder is not None:
         hist = recorder.rollback_histogram()
         base = f"{namespace}_rollback_depth"

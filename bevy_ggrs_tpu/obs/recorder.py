@@ -73,6 +73,7 @@ class FlightRecorder:
         self._last_resim = None
         self._last_health = None
         self._fault_cursor = 0
+        self._ledger_seq = 0  # speculation-ledger drain watermark
 
     def capture(
         self,
@@ -129,6 +130,7 @@ class FlightRecorder:
                 self._fault_cursor = len(sock_faults)
 
         rollbacks = resim = 0
+        rollback_depth = 0
         if runner is not None:
             if frame == NULL_FRAME:
                 frame = int(runner.frame)
@@ -139,6 +141,25 @@ class FlightRecorder:
                 resim = total_resim - self._last_resim
             self._last_rollbacks = total_rb
             self._last_resim = total_resim
+            # With per-tick capture at most one rollback lands per record,
+            # so the resim delta IS its depth. Across a coarser capture
+            # that sum used to be reported *as* a depth — conflating e.g.
+            # three 2-deep rollbacks with one 6-deep one. When the runner
+            # carries an enabled speculation ledger we report the max
+            # per-rollback depth in the window instead (bitwise identical
+            # for single-rollback captures); without a ledger the summed
+            # fallback remains, which the histogram labels.
+            rollback_depth = resim if rollbacks else 0
+            led = getattr(runner, "ledger", None)
+            if led is not None and getattr(led, "enabled", False):
+                entries = led.tail(self._ledger_seq)
+                if entries:
+                    self._ledger_seq = entries[-1]["seq"] + 1
+                if rollbacks:
+                    rollback_depth = max(
+                        (int(e["depth"]) for e in entries),
+                        default=rollback_depth,
+                    )
 
         slots_active = slots_free = None
         stagger_jitter = None
@@ -173,10 +194,7 @@ class FlightRecorder:
             predicted_players=predicted_players,
             rollbacks=rollbacks,
             resim_frames=resim,
-            # With per-tick capture at most one rollback lands per record,
-            # so the resim delta IS its depth; across a coarser capture it
-            # degrades to the summed depth, which the histogram labels.
-            rollback_depth=resim if rollbacks else 0,
+            rollback_depth=rollback_depth,
             checksum_frame=checksum_frame,
             checksum=checksum,
             health=health,

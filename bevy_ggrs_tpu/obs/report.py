@@ -8,6 +8,8 @@ browser. It assembles what the obs stack already collects:
 - the flight-recorder tail (last N frames per recorder) and the
   rollback-depth histogram,
 - host/device attribution rows from benches,
+- speculation-ledger branch economics (outcomes, hit ranks, waste,
+  per-player blame shares),
 - the raw metrics summary,
 
 so a failed soak ships its own forensics viewer instead of a directory
@@ -179,6 +181,49 @@ def _timeseries_section(timeseries) -> str:
     return _table(headers, rows)
 
 
+def _ledger_section(ledger) -> str:
+    s = ledger.summary() if hasattr(ledger, "summary") else dict(ledger)
+    if not s.get("rollbacks"):
+        return "<p class='small'>no rollbacks recorded</p>"
+    outcome_rows = [
+        ["full hits", s["spec_full"]],
+        ["partial hits", s["spec_partial"]],
+        ["misses", s["spec_miss"]],
+        ["unmatched", s["spec_unmatched"]],
+        ["rollbacks total", s["rollbacks"]],
+    ]
+    econ_rows = [
+        ["full-hit rate", f"{s['spec_full_hit_rate']:.3f}"],
+        ["hit rank p50", s["spec_hit_rank_p50"]],
+        ["hit rank p99", s["spec_hit_rank_p99"]],
+        ["waste ratio", f"{s['spec_waste_ratio']:.3f}"],
+        ["spec frames dispatched", s["spec_frames_dispatched"]],
+        ["frames recovered", s["frames_recovered_total"]],
+        ["frames resimulated", s["frames_resimulated_total"]],
+    ]
+    parts = [
+        "<h3>outcomes</h3>", _table(["outcome", "count"], outcome_rows),
+        "<h3>branch economics</h3>", _table(["stat", "value"], econ_rows),
+    ]
+    shares = (
+        ledger.blame_shares() if hasattr(ledger, "blame_shares") else {}
+    )
+    if shares:
+        parts.append("<h3>blame by player</h3>")
+        parts.append(
+            _table(
+                ["player", "share"],
+                [
+                    [f"player {p}", f"{share:.3f}"]
+                    for p, share in sorted(
+                        shares.items(), key=lambda kv: -kv[1]
+                    )
+                ],
+            )
+        )
+    return "".join(parts)
+
+
 def _metrics_section(metrics) -> str:
     summ = metrics.summary() if hasattr(metrics, "summary") else dict(metrics)
     if not summ:
@@ -200,6 +245,7 @@ def build_report(
     attribution: Optional[Dict[str, dict]] = None,
     metrics=None,
     timeseries=None,
+    ledger=None,
     notes: Optional[str] = None,
 ) -> str:
     """Render the report; write it to ``path`` when given. ``slo`` is a
@@ -207,7 +253,9 @@ def build_report(
     ``tracers`` / ``recorders`` map component name -> object;
     ``attribution`` maps bench name -> attribution row dict;
     ``timeseries`` is a :class:`~bevy_ggrs_tpu.obs.timeseries.TimeSeries`
-    or its ``snapshot()`` dict."""
+    or its ``snapshot()`` dict; ``ledger`` is a
+    :class:`~bevy_ggrs_tpu.obs.ledger.SpeculationLedger` or its
+    ``summary()`` dict."""
     sections = []
     if notes:
         sections.append(f"<p>{_esc(notes)}</p>")
@@ -223,6 +271,10 @@ def build_report(
         sections.append(
             "<h2>Time series (live windows)</h2>"
             + _timeseries_section(timeseries)
+        )
+    if ledger is not None:
+        sections.append(
+            "<h2>Speculation ledger</h2>" + _ledger_section(ledger)
         )
     if tracers:
         sections.append("<h2>Span summaries</h2>" + _spans_section(tracers))

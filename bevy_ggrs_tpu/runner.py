@@ -59,12 +59,21 @@ class RollbackRunner:
         mesh=None,
         entity_axis: str = "entity",
         tracer=None,
+        ledger=None,
     ):
+        from bevy_ggrs_tpu.obs.ledger import null_ledger
         from bevy_ggrs_tpu.obs.trace import null_tracer
         from bevy_ggrs_tpu.utils.metrics import null_metrics
 
         self.metrics = metrics if metrics is not None else null_metrics
         self.tracer = tracer if tracer is not None else null_tracer
+        self.ledger = ledger if ledger is not None else null_ledger
+        # One-shot outcome handoff from the speculative matcher: when a
+        # match was attempted and missed, _try_commit stashes the causal
+        # detail here before falling back to this serial path, which
+        # records THE ledger entry for that rollback (one entry per
+        # rollback, never two).
+        self._ledger_note: Optional[dict] = None
         self.schedule = schedule
         self.num_players = int(num_players)
         self.input_spec = input_spec
@@ -227,6 +236,20 @@ class RollbackRunner:
             self.metrics.count("rollbacks")
             self.metrics.count("rollback_frames", depth)
             self.metrics.observe("rollback_depth", depth)
+            # The serial path's ledger entry: outcome detail comes from
+            # the one-shot note when the speculative matcher ran and
+            # missed; a rollback that never reached a matcher (no pending
+            # rollout, restore-path recovery, plain runner) is
+            # "unmatched".
+            note, self._ledger_note = self._ledger_note, None
+            note = note or {}
+            self.ledger.record(
+                note.pop("outcome", "unmatched"),
+                depth=depth, frames_resimulated=depth,
+                load_frame=load_frame, **note,
+            )
+        else:
+            self._ledger_note = None
         self.frame = frame
 
     # ------------------------------------------------------------------

@@ -62,6 +62,7 @@ import numpy as np
 
 from bevy_ggrs_tpu.fused import FusedTickExecutor, _i32_cached
 from bevy_ggrs_tpu.native import spec as native_spec
+from bevy_ggrs_tpu.obs.ledger import blame_divergence
 from bevy_ggrs_tpu.parallel.speculate import match_branch
 from bevy_ggrs_tpu.runner import RollbackRunner, _Step
 from bevy_ggrs_tpu.schedule import PREDICTED, Schedule
@@ -235,7 +236,9 @@ class BatchedSessionCore:
         executor: Optional[BatchedTickExecutor] = None,
         report_checksums: bool = True,
         timeseries=None,
+        ledger=None,
     ):
+        from bevy_ggrs_tpu.obs.ledger import null_ledger
         from bevy_ggrs_tpu.obs.timeseries import null_timeseries
         from bevy_ggrs_tpu.obs.trace import null_tracer
         from bevy_ggrs_tpu.utils.metrics import null_metrics
@@ -245,6 +248,10 @@ class BatchedSessionCore:
         self.timeseries = (
             timeseries if timeseries is not None else null_timeseries
         )
+        # Per-rollback causal accounting (obs/ledger.py). A MatchServer
+        # passes a scoped view so entries carry fleet-unique flat slot
+        # ids; entries here label the local match_slot.
+        self.ledger = ledger if ledger is not None else null_ledger
         # Host-work decomposition arms only when someone is listening —
         # the clock reads would otherwise tax the per-slot loop for
         # nothing (the telemetry-off determinism guard stays exact).
@@ -628,6 +635,8 @@ class BatchedSessionCore:
                 s.input_log[start + t] = np.asarray(st.adv.bits)
             # Branch-commit decision (host-side, zero device syncs).
             absorb_branch, n_commit = 0, 0
+            missed = False
+            blame_player = blame_frame = None
             if (
                 load_frame is not None
                 and s.res_anchor is not None
@@ -661,11 +670,26 @@ class BatchedSessionCore:
                     if nc > 0:
                         absorb_branch, n_commit = int(br), int(nc)
                     else:
+                        missed = True
                         self.spec_misses += 1
                         self.metrics.count("spec_misses")
                         self.metrics.count(
                             "spec_misses", labels={"match_slot": i}
                         )
+                    if self.ledger.enabled:
+                        # Blame: first corrected input diverging from the
+                        # branch-0 prediction rows (pure NumPy on the
+                        # host-resident branch tensor).
+                        pre = load_frame - s.res_anchor
+                        k = min(n_steps, F - pre)
+                        if k > 0:
+                            div = blame_divergence(
+                                np.asarray(s.res_bits)[0][pre:pre + k],
+                                steps_arr[:k],
+                            )
+                            if div is not None:
+                                blame_player = div[1]
+                                blame_frame = load_frame + div[0]
             # The next rollout. Speculation is active only when the anchor
             # lies inside the post-burst ring window; otherwise the lane
             # still computes a (discarded) rollout from the live frontier.
@@ -713,7 +737,7 @@ class BatchedSessionCore:
                 end, spec_active, anchor if spec_active else None,
                 bb if spec_active else None,
                 from_live, load_frame, n_commit, n_steps, burst_start,
-                n_tail, session,
+                n_tail, session, missed, blame_player, blame_frame,
             )
 
         if measure:
@@ -743,13 +767,21 @@ class BatchedSessionCore:
 
         for i, (
             end, spec_active, res_anchor, res_bits, from_live, load_frame,
-            n_commit, n_steps, burst_start, n_tail, session,
+            n_commit, n_steps, burst_start, n_tail, session, missed,
+            blame_player, blame_frame,
         ) in post.items():
             s = self.slots[i]
             s.frame = end
             if spec_active:
                 s.res_anchor, s.res_bits = res_anchor, res_bits
                 s.res_from_live = from_live
+                # A fresh rollout dispatched for this slot: B×F
+                # speculative device frames. (No-op lane replays are NOT
+                # charged — they are an artifact of the wholesale
+                # prev-buffer swap, not new speculative intent.)
+                self.ledger.record_rollout(
+                    self.num_branches * self.spec_frames, slot=i
+                )
             else:
                 s.res_anchor, s.res_bits = None, None
             lab = {"match_slot": i}
@@ -760,6 +792,19 @@ class BatchedSessionCore:
                 self.metrics.count("rollbacks")
                 self.metrics.count("rollbacks", labels=lab)
                 self.metrics.observe("rollback_depth", n_steps)
+                outcome = (
+                    ("full" if n_commit == n_steps else "partial")
+                    if n_commit > 0
+                    else ("miss" if missed else "unmatched")
+                )
+                self.ledger.record(
+                    outcome, depth=n_steps, frames_recovered=n_commit,
+                    frames_resimulated=n_steps - n_commit,
+                    branch=branch_a[i] if n_commit > 0 else None,
+                    rank=branch_a[i] if n_commit > 0 else None,
+                    blame_player=blame_player, blame_frame=blame_frame,
+                    slot=i, load_frame=load_frame,
+                )
                 if n_commit > 0:
                     self.rollback_frames_recovered_total += n_commit
                     self.metrics.count("rollback_frames_recovered", n_commit)

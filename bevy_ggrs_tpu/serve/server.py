@@ -131,7 +131,9 @@ class MatchServer:
         timeseries=None,
         admit_budget: int = 4,
         admission_slo_ms: Optional[float] = None,
+        ledger=None,
     ):
+        from bevy_ggrs_tpu.obs.ledger import null_ledger
         from bevy_ggrs_tpu.obs.slo import SlotSLO, WindowSLO
         from bevy_ggrs_tpu.obs.timeseries import null_timeseries
         from bevy_ggrs_tpu.obs.trace import null_tracer
@@ -148,6 +150,11 @@ class MatchServer:
         self.timeseries = (
             timeseries if timeseries is not None else null_timeseries
         )
+        # ONE server-level speculation ledger; each slot group writes
+        # through a scoped view so entries carry the server-wide flat
+        # slot id (group * per_group + slot — the SLO/metrics key).
+        self.ledger = ledger if ledger is not None else null_ledger
+        self._ledger_seq = 0  # run_frame's incremental tail() watermark
         self.frame_ms = float(frame_ms)
         self._clock = clock
         # Watchdog: a session's host work (poll + inputs + advance) gets
@@ -177,8 +184,9 @@ class MatchServer:
                 metrics=self.metrics, tracer=self.tracer,
                 executor=self._exec, report_checksums=report_checksums,
                 timeseries=self.timeseries,
+                ledger=self.ledger.scoped(g * per_group),
             )
-            for _ in range(G)
+            for g in range(G)
         ]
         # Lane-runner construction parameters (recovery lanes are built
         # on demand; they all share one warmed rollout executable so the
@@ -236,14 +244,20 @@ class MatchServer:
             if admission_slo_ms is None
             else float(admission_slo_ms)
         )
+        objectives = {
+            "admission": (
+                "admission_ms", self.admission_slo_ms, 0.99,
+            ),
+            "frame_deadline": ("frame_ms", self.frame_ms, 0.99),
+        }
+        if self.ledger.enabled:
+            # spec_spill is 0.0 for a fully-absorbed rollback and 1.0
+            # otherwise (WindowSLO counts samples ABOVE threshold as
+            # bad): the objective is 75% of rollbacks fully absorbed.
+            objectives["spec_spill"] = ("spec_spill", 0.5, 0.75)
         self.window_slo = WindowSLO(
             self.timeseries,
-            {
-                "admission": (
-                    "admission_ms", self.admission_slo_ms, 0.99,
-                ),
-                "frame_deadline": ("frame_ms", self.frame_ms, 0.99),
-            },
+            objectives,
             config=slo_config,
             metrics=self.metrics,
         )
@@ -304,6 +318,15 @@ class MatchServer:
         colocated with their servers."""
         from bevy_ggrs_tpu.session.protocol import FleetHeartbeat
 
+        spec_hit_permille = spec_waste_permille = 0
+        if self.ledger.enabled:
+            s = self.ledger.summary()
+            spec_hit_permille = int(
+                round(1000.0 * s["spec_full_hit_rate"])
+            )
+            spec_waste_permille = int(
+                round(1000.0 * s["spec_waste_ratio"])
+            )
         return FleetHeartbeat(
             server_id=self.server_id,
             frames_served=self.frames_served,
@@ -313,6 +336,8 @@ class MatchServer:
             pages=sum(
                 1 for lvl in self.slo_levels.values() if lvl == "page"
             ),
+            spec_hit_permille=spec_hit_permille,
+            spec_waste_permille=spec_waste_permille,
         )
 
     def free_slot_handles(self) -> List[MatchHandle]:
@@ -946,6 +971,34 @@ class MatchServer:
             self.timeseries.observe(
                 "admit_queue_depth", len(self._admit_queue)
             )
+            if self.ledger.enabled:
+                # Incremental ledger drain into the live windows: one
+                # spec_spill sample per rollback (0 = fully absorbed —
+                # the WindowSLO objective), per-player blame streams,
+                # and the hit-rank distribution.
+                for e in self.ledger.tail(self._ledger_seq):
+                    self._ledger_seq = e["seq"] + 1
+                    self.timeseries.observe(
+                        "spec_spill",
+                        0.0 if e["outcome"] == "full" else 1.0,
+                    )
+                    if e.get("rank") is not None:
+                        self.timeseries.observe(
+                            "spec_hit_rank", float(e["rank"])
+                        )
+                    bp = e.get("blame_player")
+                    if bp is not None:
+                        self.timeseries.observe(f"spec_blame_p{bp}", 1.0)
+                disp = self.ledger.spec_frames_dispatched
+                if disp:
+                    self.timeseries.observe(
+                        "spec_waste_ratio",
+                        max(
+                            0.0,
+                            1.0
+                            - self.ledger.frames_recovered_total / disp,
+                        ),
+                    )
         if self.frames_served % self.slo_export_interval == 0:
             self.slo_levels = self.slo.export()
             for handle, m in self._matches.items():
@@ -1001,8 +1054,13 @@ class MatchServer:
             timeseries=(
                 self.timeseries if self.timeseries.enabled else None
             ),
+            ledger=self.ledger if self.ledger.enabled else None,
         )
         out["metrics"] = p
+        if self.ledger.enabled:
+            p = _os.path.join(directory, f"{prefix}_spec_ledger.jsonl")
+            self.ledger.export_jsonl(p)
+            out["spec_ledger"] = p
         p = _os.path.join(directory, f"{prefix}_slo.json")
         with open(p, "w") as f:
             _json.dump(self.slo.snapshot(), f, indent=2)
@@ -1022,6 +1080,7 @@ class MatchServer:
             timeseries=(
                 self.timeseries if self.timeseries.enabled else None
             ),
+            ledger=self.ledger if self.ledger.enabled else None,
             notes=(
                 f"frames_served={self.frames_served} "
                 f"faults={self.faults_total} "

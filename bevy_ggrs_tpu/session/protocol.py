@@ -336,6 +336,10 @@ class FleetHeartbeat:
     slots_free: int
     quarantined: int
     pages: int
+    # Speculation-ledger rollup (permille, 0 when the ledger is off):
+    # lifetime full-hit rate and waste ratio across the server's slots.
+    spec_hit_permille: int = 0
+    spec_waste_permille: int = 0
 
 
 Message = Union[
@@ -363,7 +367,9 @@ _MIG_OFFER = struct.Struct("<IIiHQ")  # nonce, match_id, frame, total, digest
 _MIG_ACCEPT = struct.Struct("<IB")  # nonce, accept
 _MIG_CHUNK = struct.Struct("<IiHHI")  # nonce, frame, seq, total, crc
 _MIG_DONE = struct.Struct("<IiB")  # nonce, frame, ok
-_FLEET_HB = struct.Struct("<HIHHHH")  # id, frames, active, free, quar, pages
+_FLEET_HB = struct.Struct(
+    "<HIHHHHHH"
+)  # id, frames, active, free, quar, pages, spec_hit_pm, spec_waste_pm
 
 
 def encode(msg: Message) -> bytes:
@@ -472,6 +478,7 @@ def encode(msg: Message) -> bytes:
             msg.server_id & 0xFFFF, msg.frames_served & 0xFFFFFFFF,
             msg.slots_active & 0xFFFF, msg.slots_free & 0xFFFF,
             msg.quarantined & 0xFFFF, msg.pages & 0xFFFF,
+            msg.spec_hit_permille & 0xFFFF, msg.spec_waste_permille & 0xFFFF,
         )
     raise TypeError(f"unknown message {msg!r}")
 
@@ -565,10 +572,12 @@ def decode(data: bytes) -> Optional[Message]:
             nonce, frame, ok = _MIG_DONE.unpack_from(body)
             return MigrateDone(nonce, frame, ok)
         if mtype == T_FLEET_HEARTBEAT:
-            sid, frames, active, free, quar, pages = _FLEET_HB.unpack_from(
-                body
+            (
+                sid, frames, active, free, quar, pages, hit_pm, waste_pm
+            ) = _FLEET_HB.unpack_from(body)
+            return FleetHeartbeat(
+                sid, frames, active, free, quar, pages, hit_pm, waste_pm
             )
-            return FleetHeartbeat(sid, frames, active, free, quar, pages)
         return None
     except struct.error:
         return None

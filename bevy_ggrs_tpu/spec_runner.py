@@ -49,6 +49,7 @@ import numpy as np
 
 from bevy_ggrs_tpu.fused import FusedTickExecutor, absorb_branch_frames
 from bevy_ggrs_tpu.native import spec as native_spec
+from bevy_ggrs_tpu.obs.ledger import blame_divergence
 from bevy_ggrs_tpu.parallel.speculate import (
     SpecResult,
     SpeculativeExecutor,
@@ -760,6 +761,7 @@ class SpeculativeRollbackRunner(RollbackRunner):
         into the post-restore one."""
         self._result = None
         self._spec_sig = None
+        self._ledger_note = None
         self._input_log.clear()
         # Reports computed from the pre-restore world must not surface
         # into the post-restore session.
@@ -915,6 +917,8 @@ class SpeculativeRollbackRunner(RollbackRunner):
         # else.
         res = self._result
         absorb_branch, n_commit = 0, 0
+        missed = False
+        blame_player = blame_frame = None
         if (
             load_frame is not None
             and res is not None
@@ -954,8 +958,13 @@ class SpeculativeRollbackRunner(RollbackRunner):
                 if nc > 0:
                     absorb_branch, n_commit = int(branch), int(nc)
                 else:
+                    missed = True
                     self.spec_misses += 1
                     self.metrics.count("spec_misses")
+                if self.ledger.enabled:
+                    blame_player, blame_frame = self._ledger_blame(
+                        res, load_frame, steps
+                    )
         if n_commit == n_steps and n_commit > 0:
             # FULL hit: the corrected frames were precomputed — ONE
             # absorb-only dispatch (pure copies, no schedule execution)
@@ -967,6 +976,12 @@ class SpeculativeRollbackRunner(RollbackRunner):
             # and the next steady tick refreshes it fused with its burst.
             self._commit_full_hit(
                 load_frame, n_commit, absorb_branch, res, steps, session
+            )
+            self.ledger.record(
+                "full", depth=n_steps, frames_recovered=n_commit,
+                branch=absorb_branch, rank=absorb_branch,
+                blame_player=blame_player, blame_frame=blame_frame,
+                load_frame=load_frame,
             )
             self._gc_log()
             return
@@ -1093,6 +1108,9 @@ class SpeculativeRollbackRunner(RollbackRunner):
             branch_bits=bits, start_frame=int(anchor),
             num_frames=self.spec_frames,
         )
+        # The fused program just dispatched the NEXT rollout's B×F
+        # speculative device frames (the waste-ratio numerator).
+        self.ledger.record_rollout(self.num_branches * self.spec_frames)
         self.frame = end
         # Counters — identical accounting to the legacy pair.
         self.metrics.count("frames_advanced", n_steps)
@@ -1114,6 +1132,18 @@ class SpeculativeRollbackRunner(RollbackRunner):
             else:
                 self.rollback_frames_total += n_steps
                 self.metrics.count("rollback_frames", n_steps)
+            outcome = (
+                ("full" if n_commit == n_steps else "partial")
+                if n_commit > 0 else ("miss" if missed else "unmatched")
+            )
+            self.ledger.record(
+                outcome, depth=n_steps, frames_recovered=n_commit,
+                frames_resimulated=n_steps - n_commit,
+                branch=absorb_branch if n_commit > 0 else None,
+                rank=absorb_branch if n_commit > 0 else None,
+                blame_player=blame_player, blame_frame=blame_frame,
+                load_frame=load_frame,
+            )
         # Checksum reporting: queue only the frames the session wants;
         # the device arrays are read next tick (see docstring).
         if session is not None and self.report_checksums:
@@ -1347,6 +1377,10 @@ class SpeculativeRollbackRunner(RollbackRunner):
             branch_bits=branch_bits,
         )
         self.device_dispatches_total += 1
+        # B×F speculative device frames per rollout (covers speculate(),
+        # warmup, and the attestation replays — all branch compute the
+        # waste ratio charges against committed frames).
+        self.ledger.record_rollout(self.num_branches * self.spec_frames)
         ring, state, _, _, spec_rings, spec_states, spec_cs = out
         self.ring, self.state = ring, state  # value-identical pass-through
         return SpecResult(
@@ -1640,6 +1674,28 @@ class SpeculativeRollbackRunner(RollbackRunner):
 
     # ------------------------------------------------------------------
 
+    def _ledger_blame(self, res: SpecResult, load_frame: int, steps):
+        """``(blame_player, blame_frame)`` for the ledger entry: the first
+        input at which the corrected history diverges from branch 0's
+        prediction rows over the rollback span. Gated on
+        ``ledger.enabled`` at every call site; ``res.branch_bits`` is
+        already host-resident on the match paths, so this is pure NumPy —
+        no device sync. ``(None, None)`` when branch 0 agreed (the
+        rollback came from pre-span history or a session-level prediction
+        the rollout never modeled)."""
+        pre = load_frame - res.start_frame
+        k = min(len(steps), res.num_frames - pre)
+        if k <= 0:
+            return None, None
+        b0 = np.asarray(res.branch_bits)[0]
+        corrected = np.stack(
+            [np.asarray(s.adv.bits) for s in steps[:k]]
+        )
+        hit = blame_divergence(b0[pre:pre + k], corrected)
+        if hit is None:
+            return None, None
+        return hit[1], load_frame + hit[0]
+
     def _try_commit(self, load_frame: int, steps: List[_Step], session) -> bool:
         """Commit a matching branch for a ``[Load, (Save, Advance)*]``
         burst; returns False (→ serial fallback) when no branch matches."""
@@ -1692,6 +1748,15 @@ class SpeculativeRollbackRunner(RollbackRunner):
         if n_commit <= 0:
             self.spec_misses += 1
             self.metrics.count("spec_misses")
+            if self.ledger.enabled:
+                # The serial fallback that follows records THE entry for
+                # this rollback; hand it the causal detail the matcher
+                # just computed (one-shot, consumed by _run_segment).
+                bp, bf = self._ledger_blame(res, load_frame, steps)
+                self._ledger_note = {
+                    "outcome": "miss", "blame_player": bp,
+                    "blame_frame": bf,
+                }
             return False
 
         with self.metrics.timer("spec_commit"):
@@ -1730,6 +1795,17 @@ class SpeculativeRollbackRunner(RollbackRunner):
         self.metrics.count("rollback_frames_recovered", n_commit)
         self.metrics.count("frames_advanced", n_commit)
         self.metrics.observe("rollback_depth", n_steps)
+        if self.ledger.enabled:
+            bp, bf = self._ledger_blame(res, load_frame, steps)
+        else:
+            bp = bf = None
+        self.ledger.record(
+            "full" if n_commit == n_steps else "partial",
+            depth=n_steps, frames_recovered=n_commit,
+            frames_resimulated=n_steps - n_commit,
+            branch=int(branch), rank=int(branch),
+            blame_player=bp, blame_frame=bf, load_frame=load_frame,
+        )
         if n_commit == n_steps:
             self.spec_hits += 1
             self.metrics.count("spec_hits")

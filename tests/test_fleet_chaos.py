@@ -41,7 +41,13 @@ from bevy_ggrs_tpu.chaos import (
 )
 from bevy_ggrs_tpu.fleet import FleetBalancer
 from bevy_ggrs_tpu.models import box_game
-from bevy_ggrs_tpu.obs import ProvenanceLog, SidecarSocket, SpanTracer, merge_traces
+from bevy_ggrs_tpu.obs import (
+    ProvenanceLog,
+    SidecarSocket,
+    SpanTracer,
+    SpeculationLedger,
+    merge_traces,
+)
 from bevy_ggrs_tpu.runner import RollbackRunner
 from bevy_ggrs_tpu.serve import MatchServer
 from bevy_ggrs_tpu.session.requests import AdvanceFrame, SaveGameState
@@ -126,7 +132,7 @@ def test_fleet_directives_json_roundtrip():
 
 
 def build_fleet_server(k, net, metrics, ckpt_dir, capacity, groups,
-                       tracer=None):
+                       tracer=None, ledger=None):
     server = MatchServer(
         box_game.make_schedule(), box_game.make_world(2).commit(),
         MAX_PRED, 2, box_game.INPUT_SPEC,
@@ -136,6 +142,7 @@ def build_fleet_server(k, net, metrics, ckpt_dir, capacity, groups,
         checkpoint_dir=ckpt_dir, checkpoint_interval=120,
         server_id=k, fleet_socket=net.socket(("hb", k)),
         fleet_addr=("fleet", "bal"), heartbeat_interval=8,
+        ledger=ledger,
     )
     server.warmup()
     return server
@@ -176,6 +183,13 @@ def run_fleet_soak(plan, n_matches, n_iters, capacity, groups, ckpt_root,
                        process_name=f"srv{k}") if obs_dir else None)
         for k in (0, 1)
     }
+    ledgers = {
+        k: (
+            SpeculationLedger(component=f"srv{k}-spec", pid=510 + k)
+            if obs_dir else None
+        )
+        for k in (0, 1)
+    }
     metrics = {k: Metrics() for k in (0, 1)}
     bal = FleetBalancer(
         socket=net.socket(("fleet", "bal")), addr=("fleet", "bal"),
@@ -186,7 +200,8 @@ def run_fleet_soak(plan, n_matches, n_iters, capacity, groups, ckpt_root,
     for k in (0, 1):
         ckpt = os.path.join(ckpt_root, f"srv{k}")
         servers[k] = build_fleet_server(
-            k, net, metrics[k], ckpt, capacity, groups, tracers[k]
+            k, net, metrics[k], ckpt, capacity, groups, tracers[k],
+            ledgers[k],
         )
         msock = net.socket(("mig", k))
         if obs_dir:
@@ -257,6 +272,18 @@ def run_fleet_soak(plan, n_matches, n_iters, capacity, groups, ckpt_root,
             p = os.path.join(obs_dir, f"fleet_soak_srv{k}_trace.json")
             tracer.export_perfetto(p)
             trace_paths.append(p)
+        for k, led in ledgers.items():
+            led.export_jsonl(
+                os.path.join(obs_dir, f"fleet_soak_srv{k}_spec_ledger.jsonl")
+            )
+            # Blamed-input -> resim flow arrows on the merged timeline,
+            # keyed by the causal rx input datagram at server k.
+            if f"srv{k}" in prov:
+                p = os.path.join(
+                    obs_dir, f"fleet_soak_srv{k}_spec_provenance.jsonl"
+                )
+                if led.export_provenance(p, prov[f"srv{k}"]):
+                    prov_paths.append(p)
         merge_traces(
             trace_paths, prov_paths,
             path=os.path.join(obs_dir, "fleet_soak_merged_trace.json"),
@@ -335,6 +362,8 @@ def test_fleet_soak_exports_cross_server_migration_trace(
         "fleet_soak_ext1_provenance.jsonl",
         "fleet_soak_srv0_trace.json",
         "fleet_soak_srv1_trace.json",
+        "fleet_soak_srv0_spec_ledger.jsonl",
+        "fleet_soak_srv1_spec_ledger.jsonl",
         "fleet_soak_merged_trace.json",
     ):
         p = obs / f

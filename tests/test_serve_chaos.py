@@ -41,6 +41,7 @@ from bevy_ggrs_tpu.obs import (
     ProvenanceLog,
     SidecarSocket,
     SpanTracer,
+    SpeculationLedger,
     frame_flows,
     merge_traces,
 )
@@ -120,7 +121,8 @@ def server_inputs(frame, handle):
     return scripted_input(handle, frame)
 
 
-def build_server(ckpt_dir, capacity, groups, net, metrics, tracer=None):
+def build_server(ckpt_dir, capacity, groups, net, metrics, tracer=None,
+                 ledger=None):
     server = MatchServer(
         box_game.make_schedule(), box_game.make_world(2).commit(),
         MAX_PRED, 2, box_game.INPUT_SPEC,
@@ -128,6 +130,7 @@ def build_server(ckpt_dir, capacity, groups, net, metrics, tracer=None):
         num_branches=BRANCHES, spec_frames=SPEC_FRAMES,
         metrics=metrics, clock=lambda: net.now, tracer=tracer,
         checkpoint_dir=ckpt_dir, checkpoint_interval=120,
+        ledger=ledger,
     )
     server.warmup()
     return server
@@ -249,7 +252,14 @@ def run_served_soak(
         return SidecarSocket(sock, log)
 
     tap = tap if obs_dir else None
-    server = build_server(ckpt_dir, capacity, groups, net, metrics, tracer)
+    # One server-lifetime speculation ledger: passed through kill/restart
+    # rebuilds (like the tracer) so blame/economics stay one timeline.
+    ledger = (
+        SpeculationLedger(component="spec-ledger", pid=501)
+        if obs_dir else None
+    )
+    server = build_server(ckpt_dir, capacity, groups, net, metrics, tracer,
+                          ledger)
     ext = {m: make_ext_peer(net, m, plan, tap) for m in range(n_matches)}
     handle_of = {
         m: server.add_match(make_host_session(net, m, tap), server_inputs)
@@ -296,7 +306,7 @@ def run_served_soak(
                 k["killed"] = True
             elif k["killed"] and not k["done"] and net.now >= k["until"]:
                 server = build_server(ckpt_dir, capacity, groups, net,
-                                      metrics, tracer)
+                                      metrics, tracer, ledger)
                 attachments = {
                     (h.group, h.slot): {
                         "session": make_host_session(net, m, tap),
@@ -342,6 +352,13 @@ def run_served_soak(
             arts = server.export_telemetry(obs_dir, prefix="serve_soak")
             if arts and "trace" in arts:
                 trace_paths.append(arts["trace"])
+        if ledger is not None and "server" in prov:
+            # Blamed-input flow arrows: re-emit each blamed entry keyed
+            # by its causal rx input datagram so the merged trace draws
+            # sender-tx -> server-rx -> spec_resim across process tracks.
+            p = os.path.join(obs_dir, "serve_soak_spec_provenance.jsonl")
+            if ledger.export_provenance(p, prov["server"]):
+                prov_paths.append(p)
         merge_traces(
             trace_paths, prov_paths,
             path=os.path.join(obs_dir, "serve_soak_merged_trace.json"),
@@ -418,6 +435,7 @@ def test_soak_exports_fleet_trace_artifacts(tmp_path, monkeypatch):
         "serve_soak_metrics.prom",
         "serve_soak_slo.json",
         "serve_soak_report.html",
+        "serve_soak_spec_ledger.jsonl",
         "serve_soak_merged_trace.json",
     ):
         p = obs / f

@@ -21,7 +21,9 @@ from bevy_ggrs_tpu.obs import (
     SidecarSocket,
     SpanTracer,
 )
+from bevy_ggrs_tpu.obs.ledger import SpeculationLedger
 from bevy_ggrs_tpu.runner import RollbackRunner
+from bevy_ggrs_tpu.spec_runner import SpeculativeRollbackRunner
 from bevy_ggrs_tpu.session import (
     PlayerType,
     PredictionThreshold,
@@ -132,6 +134,104 @@ class TestP2PInert:
         assert on[2] == off[2]
 
 
+def run_p2p_spec(ledger_on: bool):
+    """Same chaos pair, but peer 0 SPECULATES — the only variable is the
+    speculation ledger, so a ledger that touched the wire, moved a chaos
+    RNG draw, or perturbed the branch tree breaks the byte compare."""
+    net = LoopbackNetwork()
+    plan = ChaosPlan.generate(11, 3.0, (("peer", 0), ("peer", 1)))
+    wires = {0: [], 1: []}
+    history = [{}, {}]
+    peers = []
+    for me in range(2):
+        sock = WireRecorder(net.socket(("peer", me)), wires[me])
+        sock = ChaosSocket(
+            sock, plan, clock=lambda: net.now, addr=("peer", me)
+        )
+        builder = (
+            SessionBuilder(box_game.INPUT_SPEC)
+            .with_num_players(2)
+            .with_max_prediction_window(8)
+        )
+        for h in range(2):
+            builder.add_player(
+                PlayerType.local() if h == me
+                else PlayerType.remote(("peer", h)), h,
+            )
+        session = builder.start_p2p_session(sock, clock=lambda: net.now)
+        if me == 0:
+            runner = SpeculativeRollbackRunner(
+                box_game.make_schedule(), box_game.make_world(2).commit(),
+                max_prediction=8, num_players=2,
+                input_spec=box_game.INPUT_SPEC,
+                num_branches=16, spec_frames=8,
+                ledger=SpeculationLedger() if ledger_on else None,
+            )
+        else:
+            runner = RollbackRunner(
+                box_game.make_schedule(), box_game.make_world(2).commit(),
+                max_prediction=8, num_players=2,
+                input_spec=box_game.INPUT_SPEC,
+            )
+        peers.append((session, runner))
+    for _ in range(240):
+        net.advance(FPS_DT)
+        for i, (session, runner) in enumerate(peers):
+            session.poll_remote_clients()
+            if session.current_state() != SessionState.RUNNING:
+                continue
+            for h in session.local_player_handles():
+                session.add_local_input(
+                    h, scripted_input(h, session.current_frame)
+                )
+            try:
+                runner.handle_requests(session.advance_frame(), session)
+            except PredictionThreshold:
+                continue
+            if isinstance(runner, SpeculativeRollbackRunner):
+                runner.speculate(session.confirmed_frame(), session)
+            history[i].update(session._local_checksums)
+    assert all(s.current_frame >= 150 for s, _ in peers)
+    r0 = peers[0][1]
+    assert r0.rollbacks_total > 0 and r0.spec_hits + r0.spec_partial_hits > 0
+    final = [combine64(checksum(r.state)) for _, r in peers]
+    return wires, history, final
+
+
+class TestLedgerInert:
+    def test_ledger_on_vs_off_is_wire_bitwise_identical(self):
+        on = run_p2p_spec(ledger_on=True)
+        off = run_p2p_spec(ledger_on=False)
+        assert on[0] == off[0]
+        assert on[1] == off[1]
+        assert on[2] == off[2]
+
+    def test_batched_s8_ledger_on_vs_off_identical(self):
+        def run(ledger_on):
+            kw = (
+                dict(ledger=SpeculationLedger()) if ledger_on else {}
+            )
+            core = make_core(num_slots=8, **kw)
+            slots = [core.admit() for _ in range(8)]
+            scripts = {
+                s: make_script(seed=200 + s, depth=1 + (s % 4), cycles=2)
+                for s in slots
+            }
+            drive(core, scripts)
+            sums = {
+                s: combine64(checksum(core.slot_state(s))) for s in slots
+            }
+            logs = {s: dict(core.slots[s].input_log) for s in slots}
+            return sums, logs
+
+        on_sums, on_logs = run(True)
+        off_sums, off_logs = run(False)
+        assert on_sums == off_sums
+        for s in on_logs:
+            for f in on_logs[s]:
+                assert np.array_equal(on_logs[s][f], off_logs[s][f])
+
+
 def run_batched(telemetry: bool, S=8):
     kw = {}
     if telemetry:
@@ -166,8 +266,8 @@ class TestBatchedInert:
 class TestEnabledOverhead:
     def test_enabled_path_overhead_within_5pct_of_frame_budget_s256(self):
         """Acceptance: the ENABLED telemetry path (spans + labeled
-        metrics) adds at most 5% of the 60 Hz frame budget per batched
-        tick at S=256."""
+        metrics + speculation ledger) adds at most 5% of the 60 Hz frame
+        budget per batched tick at S=256."""
         import time
 
         S, frame_ms = 256, 1000.0 / 60.0
@@ -175,7 +275,10 @@ class TestEnabledOverhead:
         def timed(telemetry):
             kw = {}
             if telemetry:
-                kw = dict(metrics=Metrics(), tracer=SpanTracer())
+                kw = dict(
+                    metrics=Metrics(), tracer=SpanTracer(),
+                    ledger=SpeculationLedger(),
+                )
             core = make_core(num_slots=S, **kw)
             slots = [core.admit() for _ in range(S)]
             scripts = {

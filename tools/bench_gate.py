@@ -18,7 +18,11 @@ Design constraints this encodes:
 - **Schema health.** Every row must carry metric/value/unit with value>0,
   and every ``serve_batched_*`` row must carry its device-time attribution
   verdict (``attr_verdict``) — the serve bench without attribution is a
-  regression even when the latency looks fine.
+  regression even when the latency looks fine. Spec-capable rows
+  (``live_*_spec_on*``, ``serve_batched_*``) must additionally carry the
+  speculation-ledger economics columns, and a ``*_spec_on*`` row with
+  ``spec_full_hit_rate == 0`` fails outright: a silently dead speculation
+  path used to pass on latency alone.
 
 Usage (CI)::
 
@@ -158,6 +162,25 @@ def check_row(row: dict, base: Optional[dict],
                 out.update(status="FAIL",
                            detail=f"front-door row lost its {col} column")
                 return out
+    if metric.startswith("live_") and "_spec_on" in metric or (
+        metric.startswith("serve_batched_")
+    ):
+        # Speculation-ledger economics (obs/ledger.py): every spec-capable
+        # row must carry its branch-economics columns, and a *_spec_on*
+        # row whose full-hit rate is zero means the speculation path went
+        # silently dead — that used to pass the bench on latency alone.
+        for col in ("spec_full_hit_rate", "spec_hit_rank_p50",
+                    "spec_hit_rank_p99", "spec_waste_ratio",
+                    "blame_top_player_share"):
+            if not isinstance(row.get(col), (int, float)):
+                out.update(status="FAIL",
+                           detail=f"spec row lost its {col} column")
+                return out
+        if "_spec_on" in metric and row.get("spec_full_hit_rate") <= 0:
+            out.update(status="FAIL",
+                       detail="spec_full_hit_rate == 0 on a *_spec_on* row "
+                              "(speculation path silently dead)")
+            return out
     if base is None:
         out.update(status="skipped", detail="no committed baseline row")
         return out
