@@ -876,6 +876,7 @@ def _live_common_columns(metrics, runner0, executed_ticks, tick_ms,
         ),
         fused_dispatch_floor_ms=round(fused_floor, 3),
         **_ledger_columns(getattr(runner0, "ledger", None)),
+        **_predictor_columns(runner0),
     )
 
 
@@ -901,6 +902,26 @@ def _ledger_columns(ledger) -> dict:
         blame_top_player_share=round(
             float(s["blame_top_player_share"]), 4
         ),
+    )
+
+
+def _predictor_columns(obj) -> dict:
+    """Learned-predictor columns (predict/) from a singleton runner or a
+    batched serve core: which policy seeded the branch trees
+    ("learned" = predictor-ranked candidates, "current" = the heuristic
+    recency/toggle ranker) and the mean host-side cost of one ranking
+    pass. Present on every spec-capable row — bench_gate schema-checks
+    them, and hard-fails a predictor-ON row whose full-hit rate drops
+    below the committed repeat-last floor in spec_baseline.json."""
+    bound = getattr(obj, "_predictor", None)
+    n = int(
+        getattr(obj, "predictor_rank_builds", 0)
+        or getattr(obj, "predictor_rank_dispatches", 0)
+    )
+    total = float(getattr(obj, "predictor_rank_ms_total", 0.0))
+    return dict(
+        spec_policy="learned" if bound is not None else "current",
+        predictor_rank_ms=round(total / n, 4) if n else 0.0,
     )
 
 
@@ -1987,6 +2008,7 @@ def _serve_batched_case(model: str, S: int) -> dict:
         churn_recompiles=int(churn_recompiles),
         cache_size_stable=bool(core._exec.cache_size() == cache0),
         **_ledger_columns(ledger),
+        **_predictor_columns(core),
         **attribution,
         notes=(
             "spec-ON, depth-2 rollback every 6th tick on every match; "

@@ -394,7 +394,8 @@ class GGRSPlugin:
         return self
 
     def with_speculation(
-        self, num_branches: int, branch_values=None, attest: bool = True
+        self, num_branches: int, branch_values=None, attest: bool = True,
+        predictor=None,
     ) -> "GGRSPlugin":
         """Precompute rollback recoveries with a ``num_branches``-wide
         speculative rollout each frame (P2P only; see
@@ -407,12 +408,21 @@ class GGRSPlugin:
         warmup machine-checks that the vmapped rollout and the serial burst
         agree bitwise for this model and auto-disables speculation — with a
         ``SPECULATION_DISABLED`` event in ``app.events`` — when they don't.
+
+        ``predictor`` configures the learned input predictor seeding the
+        branch tree (:mod:`bevy_ggrs_tpu.predict`): ``None`` consults
+        ``GGRS_PREDICTOR``, ``False`` forces it off, ``True``/path/weights
+        select artifacts — same contract as
+        ``SessionBuilder.with_input_predictor`` (which additionally folds
+        the weight hash into the wire handshake).
         """
         n = int(num_branches)
         self.speculation = n if n > 0 else None
         self.speculation_opts = {"attest": bool(attest)}
         if branch_values is not None:
             self.speculation_opts["branch_values"] = list(branch_values)
+        if predictor is not None:
+            self.speculation_opts["predictor"] = predictor
         return self
 
     def build(self, app: Optional[RollbackApp] = None) -> RollbackApp:

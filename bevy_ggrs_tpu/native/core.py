@@ -125,6 +125,12 @@ def _load():
     lib.ggrs_sb_log_del.restype = None
     lib.ggrs_sb_log_clear.argtypes = [ctypes.c_void_p]
     lib.ggrs_sb_log_clear.restype = None
+    lib.ggrs_sb_seed.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_uint64, u8p, u8p, u8p,
+        ctypes.c_int32]
+    lib.ggrs_sb_seed.restype = None
+    lib.ggrs_sb_clear_seed.argtypes = [ctypes.c_void_p]
+    lib.ggrs_sb_clear_seed.restype = None
     lib.ggrs_sb_build.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32, u8p, u8p,
         ctypes.c_int, ctypes.c_uint64, u8p, u64p]

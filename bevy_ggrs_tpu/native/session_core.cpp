@@ -395,6 +395,20 @@ struct SpecBuilder {
   std::vector<uint8_t> zero;      // zeros_np(P) raw: P*K*elem bytes
   std::map<int32_t, std::vector<uint8_t>> log;  // frame -> P*K*elem raw
 
+  // Learned-predictor seed (ggrs_sb_seed): the host-computed effective
+  // trajectory + candidate ranking for ONE anchor, consumed by the next
+  // build whose anchor matches. The seed is itself a pure function of
+  // (log window, anchor) on the Python side, but its bytes are folded
+  // into the dedup signature anyway — defense in depth against a stale
+  // seed pinning a tree.
+  bool seeded = false;
+  int32_t seed_anchor = 0;
+  uint64_t seed_hash = 0;          // predictor artifact content hash
+  int32_t seed_R = 0;              // candidate ranks per (player, field)
+  std::vector<uint8_t> seed_traj;  // [F, P, K] element bytes (unpinned)
+  std::vector<uint8_t> seed_cand;  // [P, K, R] element bytes
+  std::vector<uint8_t> seed_valid; // [P, K, R] 0/1
+
   size_t row_bytes() const { return size_t(K) * size_t(elem); }
   size_t frame_bytes() const { return size_t(P) * row_bytes(); }
 };
@@ -455,6 +469,30 @@ void ggrs_sb_log_del(void* p, int32_t frame) {
 }
 
 void ggrs_sb_log_clear(void* p) { static_cast<SpecBuilder*>(p)->log.clear(); }
+
+// Install the learned-predictor seed for `anchor`: traj[F,P,K] element
+// bytes (the autoregressive trajectory; build re-pins known inputs over
+// it), cand[P,K,R] element bytes + valid[P,K,R] 0/1 (rank-ordered
+// candidate values, gaps preserved so rank indices match the Python
+// eligibility mask). Consumed only by a build whose anchor matches.
+void ggrs_sb_seed(void* p, int32_t anchor, uint64_t content_hash,
+                  const uint8_t* traj, const uint8_t* cand,
+                  const uint8_t* valid, int32_t n_rank) {
+  auto* sb = static_cast<SpecBuilder*>(p);
+  const size_t PK = size_t(sb->P) * size_t(sb->K);
+  sb->seeded = true;
+  sb->seed_anchor = anchor;
+  sb->seed_hash = content_hash;
+  sb->seed_R = n_rank;
+  sb->seed_traj.assign(traj, traj + size_t(sb->F) * sb->frame_bytes());
+  sb->seed_cand.assign(
+      cand, cand + PK * size_t(n_rank) * size_t(sb->elem));
+  sb->seed_valid.assign(valid, valid + PK * size_t(n_rank));
+}
+
+void ggrs_sb_clear_seed(void* p) {
+  static_cast<SpecBuilder*>(p)->seeded = false;
+}
 
 // One-call branch-tree build: dedup signature + (unless deduplicated) the
 // packed [B, F, P, K] branch tensor. `qs` may be the session's native
@@ -525,6 +563,16 @@ int ggrs_sb_build(void* p, void* qs_v, int32_t anchor,
   sig.add(&max_logged, sizeof(max_logged));
   sig.add(&wstart, sizeof(wstart));
   sig.add(&digest, sizeof(digest));
+  // Predictor-seeded builds fold the seed bytes (hash LE64 + traj +
+  // cand + valid — the exact byte stream of PredictorSeed.fold_bytes,
+  // which the pure-Python sig tuple appends).
+  const bool use_seed = sb->seeded && sb->seed_anchor == anchor;
+  if (use_seed) {
+    sig.add(&sb->seed_hash, sizeof(sb->seed_hash));
+    sig.add(sb->seed_traj.data(), sb->seed_traj.size());
+    sig.add(sb->seed_cand.data(), sb->seed_cand.size());
+    sig.add(sb->seed_valid.data(), sb->seed_valid.size());
+  }
   *out_sig = sig.h;
   if (allow_skip && sig.h == prev_sig) return 1;
 
@@ -570,7 +618,23 @@ int ggrs_sb_build(void* p, void* qs_v, int32_t anchor,
   const int W = int(L - wstart + 1);
   bool has_pred = false;
   std::vector<int64_t> predv;
-  if (sb->log.count(L) && W >= 8) {
+  if (use_seed) {
+    // The predictor's autoregressive trajectory replaces the periodic
+    // extrapolator as the effective base (known slots re-pinned below,
+    // exactly like the Python hook in _structured_bits). Branch 0
+    // still renders the literal forward-fill prediction.
+    predv.resize(size_t(F) * PK);
+    for (size_t i = 0; i < size_t(F) * PK; ++i)
+      predv[i] = decode_elem(sb->seed_traj.data() + i * size_t(elem),
+                             elem, sb->is_signed);
+    for (int t = 0; t < F; ++t)
+      for (int h = 0; h < P; ++h)
+        if (mask[size_t(t) * size_t(P) + size_t(h)])
+          std::memcpy(predv.data() + (size_t(t) * P + size_t(h)) * K,
+                      knownv.data() + (size_t(t) * P + size_t(h)) * K,
+                      sizeof(int64_t) * size_t(K));
+    has_pred = true;
+  } else if (sb->log.count(L) && W >= 8) {
     std::vector<int64_t> histv(size_t(W) * PK);
     for (int w = 0; w < W; ++w) {
       const uint8_t* row = sb->log.at(wstart + w).data();
@@ -650,6 +714,28 @@ int ggrs_sb_build(void* p, void* qs_v, int32_t anchor,
   // first-occurrence over the newest-first <=32-frame log window, then
   // one-button toggles (recently-changed bits first), then the declared
   // universe — deduped and clamped to the universe.
+  std::vector<std::vector<int64_t>> rows(PK);
+  std::vector<std::vector<uint8_t>> rows_ok(PK);  // rank validity, gaps kept
+  size_t max_r = 0;
+  if (use_seed) {
+    // Predictor ranking: rank indices are positional (invalid ranks are
+    // skipped, not compacted) so enumeration matches the Python
+    // eligibility mask element-for-element.
+    const size_t R = size_t(sb->seed_R);
+    for (size_t hk = 0; hk < PK; ++hk) {
+      std::vector<int64_t> cand(R);
+      std::vector<uint8_t> ok(R);
+      for (size_t r = 0; r < R; ++r) {
+        cand[r] = decode_elem(
+            sb->seed_cand.data() + (hk * R + r) * size_t(elem), elem,
+            sb->is_signed);
+        ok[r] = sb->seed_valid[hk * R + r];
+      }
+      rows[hk] = std::move(cand);
+      rows_ok[hk] = std::move(ok);
+    }
+    max_r = R;
+  } else {
   std::vector<const uint8_t*> recent_frames;  // newest first
   for (auto it = sb->log.rbegin();
        it != sb->log.rend() && recent_frames.size() < 32; ++it)
@@ -657,9 +743,7 @@ int ggrs_sb_build(void* p, void* qs_v, int32_t anchor,
   const int H = int(recent_frames.size());
   const int64_t top =
       *std::max_element(sb->universe.begin(), sb->universe.end());
-  std::vector<std::vector<int64_t>> rows(PK);
   std::vector<int64_t> seqbuf(size_t(std::max(H, 1)));
-  size_t max_r = 0;
   for (int h = 0; h < P; ++h) {
     for (int k = 0; k < K; ++k) {
       const size_t hk = size_t(h) * size_t(K) + size_t(k);
@@ -689,8 +773,10 @@ int ggrs_sb_build(void* p, void* qs_v, int32_t anchor,
         }
       for (int64_t v : sb->universe) push(v);
       max_r = std::max(max_r, cand.size());
+      rows_ok[hk].assign(cand.size(), 1);
       rows[hk] = std::move(cand);
     }
+  }
   }
 
   // Rank-major enumeration over eligibility [R, F, P, K] in C order: the
@@ -703,9 +789,9 @@ int ggrs_sb_build(void* p, void* qs_v, int32_t anchor,
       for (int h = 0; h < P && count < want; ++h) {
         if (mask[size_t(t) * size_t(P) + size_t(h)]) continue;
         for (int k = 0; k < K && count < want; ++k) {
-          const std::vector<int64_t>& row =
-              rows[size_t(h) * size_t(K) + size_t(k)];
-          if (r >= row.size()) continue;
+          const size_t hk = size_t(h) * size_t(K) + size_t(k);
+          const std::vector<int64_t>& row = rows[hk];
+          if (r >= row.size() || !rows_ok[hk][r]) continue;
           const int64_t v = row[r];
           if (v == effv[(size_t(t) * P + size_t(h)) * K + size_t(k)])
             continue;

@@ -80,6 +80,28 @@ class NativeSpecBuilder:
     def log_clear(self) -> None:
         _core._lib.ggrs_sb_log_clear(self._ptr)
 
+    # Predictor seeding --------------------------------------------------
+
+    def seed(self, anchor: int, pred_seed) -> None:
+        """Install a :class:`~bevy_ggrs_tpu.predict.model.PredictorSeed`
+        for ``anchor``: the next build whose anchor matches uses the
+        predictor trajectory as its effective base and the predictor
+        ranking as its candidate rows, and folds the seed bytes into the
+        dedup signature (mirroring the Python sig-tuple append)."""
+        traj = _raw(np.asarray(pred_seed.traj, dtype=self._dtype))
+        cand = np.asarray(pred_seed.cand, dtype=self._dtype)
+        n_rank = int(cand.shape[-1])
+        valid = np.ascontiguousarray(
+            np.asarray(pred_seed.valid, dtype=bool)
+        ).reshape(-1).view(np.uint8)
+        _core._lib.ggrs_sb_seed(
+            self._ptr, int(anchor), int(pred_seed.content_hash),
+            _u8p(traj), _u8p(_raw(cand)), _u8p(valid), n_rank,
+        )
+
+    def clear_seed(self) -> None:
+        _core._lib.ggrs_sb_clear_seed(self._ptr)
+
     # Build / match ------------------------------------------------------
 
     def qset_ptr(self, session) -> Optional[int]:

@@ -51,7 +51,7 @@ from __future__ import annotations
 import json
 import time
 from collections import Counter, deque
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -403,12 +403,38 @@ null_ledger = _NullLedger()
 # Counterfactual ranking harness (offline, host-only).
 # ----------------------------------------------------------------------
 
-#: Ranking policies the harness scores. "current" is the production
-#: structured tree (history-ranked candidates + periodic extrapolation,
-#: through the native builder when it loads); "repeat_last" is the
-#: single-branch forward-fill ablation — the reference engine's whole
-#: prediction policy, and the floor any learned ranker must clear.
-POLICIES: Tuple[str, ...] = ("current", "repeat_last")
+#: Pluggable ranking-policy registry. A policy is registered under a
+#: name as a FACTORY: it receives the fresh per-run ``_ReplayBuilder``
+#: (branch-tree geometry + the growing canonical input log; it may
+#: swap the log for a native ``MirroredLog`` or attach a
+#: ``_predictor``) and returns the per-anchor callable
+#: ``fn(anchor, last, known, mask) -> (bits, n_branches)``. Built-ins:
+#:
+#: - ``current``     — the production structured tree (history-ranked
+#:   candidates + periodic extrapolation, through the native builder
+#:   when it loads);
+#: - ``repeat_last`` — the single-branch forward-fill ablation: the
+#:   reference engine's whole prediction policy, and the floor any
+#:   learned ranker must clear;
+#: - ``learned``     — the ``predict/`` tier: the committed int8 MLP
+#:   artifact seeding the same structured tree.
+#:
+#: Future rankers call :func:`register_policy` instead of editing the
+#: harness.
+#: factory(builder) -> fn(anchor, last, known, mask) -> (bits, n_branches)
+PolicyFactory = Callable[..., Callable]
+
+POLICY_REGISTRY: Dict[str, PolicyFactory] = {}
+
+
+def register_policy(name: str):
+    """Decorator: ``@register_policy("mine")`` over a policy factory."""
+
+    def deco(factory):
+        POLICY_REGISTRY[name] = factory
+        return factory
+
+    return deco
 
 
 def _replay_configs() -> Dict[str, dict]:
@@ -485,6 +511,80 @@ def _branch_values_for(input_spec) -> list:
     return list(range(16))
 
 
+# -- built-in ranking policies -----------------------------------------
+
+
+@register_policy("current")
+def _policy_current(builder: "_ReplayBuilder"):
+    """The production structured tree, native builder when it loads."""
+    from bevy_ggrs_tpu.native import spec as native_spec
+
+    native = native_spec.make_spec_builder(
+        builder.input_spec, builder.num_players, builder.num_branches,
+        builder.spec_frames, builder._branch_values,
+    )
+    if native is not None:
+        builder._input_log = native_spec.MirroredLog(native)
+
+    def fn(anchor, last, known, mask):
+        if native is not None:
+            bits, _ = native.build(anchor, None, known, mask, False, None)
+        else:
+            bits = builder._structured_bits(
+                np.asarray(last), known, mask, anchor
+            )
+        return np.asarray(bits), builder.num_branches
+
+    return fn
+
+
+@register_policy("repeat_last")
+def _policy_repeat_last(builder: "_ReplayBuilder"):
+    """The single forward-fill branch — the reference engine's whole
+    prediction policy, and the learned ranker's floor."""
+    from bevy_ggrs_tpu.spec_runner import _forward_fill
+
+    def fn(anchor, last, known, mask):
+        base = _forward_fill(np.asarray(last), known, mask)
+        return np.broadcast_to(base, (1,) + base.shape).copy(), 1
+
+    return fn
+
+
+@register_policy("learned")
+def _policy_learned(builder: "_ReplayBuilder"):
+    """The ``predict/`` tier: the committed int8 MLP artifact bound to
+    this config's universe, seeding the same structured tree the live
+    path builds (branch 0 stays repeat-last inside `_structured_bits`)."""
+    from bevy_ggrs_tpu.predict import InputPredictor, load_default
+
+    spec = builder.input_spec
+    n_field = 1
+    if getattr(spec, "shape", ()):
+        n_field = int(np.prod(spec.shape, dtype=np.int64))
+    bound = InputPredictor(load_default()).bind(
+        builder._branch_values, spec.zeros_np(1).dtype, n_field
+    )
+    if bound is None:
+        raise ValueError(
+            "learned policy: predictor does not apply to this config "
+            f"(n_field={n_field}, universe={len(builder._branch_values)})"
+        )
+    builder._predictor = bound
+
+    def fn(anchor, last, known, mask):
+        bits = builder._structured_bits(
+            np.asarray(last), known, mask, anchor
+        )
+        return np.asarray(bits), builder.num_branches
+
+    return fn
+
+
+#: Registration-ordered policy names; the CLI default scores them all.
+POLICIES: Tuple[str, ...] = tuple(POLICY_REGISTRY)
+
+
 def replay_config(
     name: str, cfg: dict, frames: int, policies=POLICIES,
 ) -> Dict[str, dict]:
@@ -493,9 +593,7 @@ def replay_config(
     tensors are built and prefix-matched against the scripted truth; no
     device rollout runs (waste here is the dispatch-side B×F accounting,
     identical to what the live ledger records per rollout)."""
-    from bevy_ggrs_tpu.native import spec as native_spec
     from bevy_ggrs_tpu.parallel.speculate import match_branch
-    from bevy_ggrs_tpu.spec_runner import _forward_fill
 
     spec = cfg["input_spec"]
     P, B, F = cfg["players"], cfg["branches"], cfg["spec_frames"]
@@ -518,12 +616,14 @@ def replay_config(
 
     out: Dict[str, dict] = {}
     for policy in policies:
-        native = None
-        if policy == "current":
-            native = native_spec.make_spec_builder(spec, P, B, F, values)
+        factory = POLICY_REGISTRY.get(policy)
+        if factory is None:
+            raise ValueError(
+                f"unknown ranking policy {policy!r} "
+                f"(registered: {', '.join(POLICY_REGISTRY)})"
+            )
         builder = _ReplayBuilder(spec, P, B, F, values)
-        if native is not None:
-            builder._input_log = native_spec.MirroredLog(native)
+        policy_fn = factory(builder)
         ledger = SpeculationLedger(capacity=frames + 1)
         full_hits = 0
         anchors = 0
@@ -532,20 +632,7 @@ def replay_config(
         builder._input_log[0] = frame_input(0)
         for a in range(1, max(2, frames - F)):
             last = builder._input_log[a - 1]
-            if policy == "current":
-                if native is not None:
-                    bits, _ = native.build(a, None, known, mask, False, None)
-                else:
-                    bits = builder._structured_bits(
-                        np.asarray(last), known, mask, a
-                    )
-                n_branches = B
-            else:  # repeat_last: the single forward-fill branch
-                base = _forward_fill(np.asarray(last), known, mask)
-                bits = np.broadcast_to(
-                    base, (1, F, P) + spec.shape
-                ).copy()
-                n_branches = 1
+            bits, n_branches = policy_fn(a, last, known, mask)
             truth = np.stack([frame_input(a + t) for t in range(F)])
             branch, depth = match_branch(np.asarray(bits), truth)
             branch, depth = int(branch), int(depth)

@@ -1,0 +1,115 @@
+"""Batched predictor ranking across the session axis.
+
+One jitted integer forward ranks candidates for **all S slots per
+dispatch**: the host gathers each slot's ``[W, P]`` window of universe
+indices (cheap dict lookups), and a single device call runs the full
+F-step autoregressive rollout plus first-step ranking for every slot at
+once — F unrolled matmuls total, instead of S x F host forwards.
+
+Bitwise contract: this is the same exact integer program as the numpy
+host path in ``predict/model.py`` (int8 operands, int32 accumulation
+via ``preferred_element_type``, identical clip/shift/argmax/stable-sort
+semantics), so ``rank(...)`` equals the per-slot
+``BoundPredictor.rollout(...)`` result element-for-element on every
+backend — property-tested in ``tests/test_predictor.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from bevy_ggrs_tpu.predict.model import _NEG, BoundPredictor
+
+
+class BatchedRanker:
+    """A :class:`BoundPredictor` compiled for whole-batch ranking.
+
+    ``rank(windows[S, W, P], anchors[S])`` returns
+    ``(traj_idx[S, F, P], order[S, P, V])`` as host numpy int32 — the
+    per-slot seeds are then rendered exactly like the singleton path.
+    One executable per (S,) shape; serve cores have a fixed slot count
+    so this compiles once.
+    """
+
+    def __init__(self, bound: BoundPredictor, frames: int):
+        import jax
+        import jax.numpy as jnp
+
+        w = bound.weights
+        self.bound = bound
+        self.frames = int(frames)
+        V = len(bound.universe)
+        W, SLOTS, PM, shift = w.window, w.value_slots, w.phase_mod, w.shift
+        w1 = jnp.asarray(w.w1)
+        b1 = jnp.asarray(w.b1)
+        w2 = jnp.asarray(w.w2)
+        b2 = jnp.asarray(w.b2)
+        slot_ok = jnp.arange(SLOTS) < V
+        neg = jnp.int32(_NEG)
+
+        def forward(x):  # [S, P, in] int8 -> [S, P, SLOTS] int32
+            acc = jnp.matmul(
+                x, w1, preferred_element_type=jnp.int32
+            ) + b1
+            h = jnp.minimum(
+                jnp.right_shift(jnp.maximum(acc, 0), shift), 127
+            ).astype(jnp.int8)
+            return jnp.matmul(
+                h, w2, preferred_element_type=jnp.int32
+            ) + b2
+
+        def run(win, anchors):  # win [S, W, P] int32, anchors [S] int32
+            S = win.shape[0]
+            P = win.shape[2]
+            trajs = []
+            first = None
+            for t in range(self.frames):
+                phase = (anchors + t) % PM  # [S]
+                oh = (
+                    win[..., None]
+                    == jnp.arange(SLOTS, dtype=jnp.int32)
+                ).astype(jnp.int8)  # [S, W, P, SLOTS]
+                feat = jnp.transpose(oh, (0, 2, 1, 3)).reshape(
+                    S, P, W * SLOTS
+                )
+                ph = (
+                    jnp.arange(PM, dtype=jnp.int32)[None, :]
+                    == phase[:, None]
+                ).astype(jnp.int8)  # [S, PM]
+                x = jnp.concatenate(
+                    [feat, jnp.broadcast_to(ph[:, None, :], (S, P, PM))],
+                    axis=-1,
+                )
+                logits = jnp.where(slot_ok, forward(x), neg)
+                if t == 0:
+                    first = logits
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                trajs.append(nxt)
+                win = jnp.concatenate(
+                    [win[:, 1:, :], nxt[:, None, :]], axis=1
+                )
+            traj = jnp.stack(trajs, axis=1)  # [S, F, P]
+            order = jnp.argsort(
+                -first[..., :V], axis=-1, stable=True
+            ).astype(jnp.int32)
+            return traj, order
+
+        self._run = jax.jit(run)
+
+    def rank(self, windows: np.ndarray,
+             anchors: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        traj, order = self._run(
+            np.ascontiguousarray(windows, dtype=np.int32),
+            np.ascontiguousarray(anchors, dtype=np.int32),
+        )
+        return np.asarray(traj), np.asarray(order)
+
+    def warmup(self, num_slots: int, num_players: int) -> None:
+        """Compile the (S,)-shaped executable outside the serve loop."""
+        self.rank(
+            np.full((num_slots, self.bound.weights.window, num_players),
+                    -1, dtype=np.int32),
+            np.ones(num_slots, dtype=np.int32),
+        )

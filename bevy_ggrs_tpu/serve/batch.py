@@ -64,6 +64,8 @@ from bevy_ggrs_tpu.fused import FusedTickExecutor, _i32_cached
 from bevy_ggrs_tpu.native import spec as native_spec
 from bevy_ggrs_tpu.obs.ledger import blame_divergence
 from bevy_ggrs_tpu.parallel.speculate import match_branch
+from bevy_ggrs_tpu.predict.batch import BatchedRanker
+from bevy_ggrs_tpu.predict.model import resolve_predictor
 from bevy_ggrs_tpu.runner import RollbackRunner, _Step
 from bevy_ggrs_tpu.schedule import PREDICTED, Schedule
 from bevy_ggrs_tpu.serve.faults import SlotFault, SlotTicket
@@ -237,6 +239,7 @@ class BatchedSessionCore:
         report_checksums: bool = True,
         timeseries=None,
         ledger=None,
+        predictor=None,
     ):
         from bevy_ggrs_tpu.obs.ledger import null_ledger
         from bevy_ggrs_tpu.obs.timeseries import null_timeseries
@@ -325,6 +328,22 @@ class BatchedSessionCore:
             self._zero, (F,) + self._zero.shape
         ).copy()
         self._mask0 = np.zeros((F, self.num_players), dtype=bool)
+        # Learned input predictor (predict/): one BOUND predictor shared
+        # by every slot (weights are per-deployment, not per-match), with
+        # a batched ranker so ONE vmapped int8 forward ranks candidates
+        # for all predictor-eligible slots per dispatch. ``predictor=
+        # None`` consults GGRS_PREDICTOR; binding falls back to None (and
+        # the heuristic ranking) when the weights don't fit this input
+        # geometry — exactly the singleton runner's resolution.
+        shape = tuple(getattr(input_spec, "shape", ()) or ())
+        n_field = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        self._predictor = resolve_predictor(
+            predictor, self._branch_values, self._zero.dtype, n_field,
+        )
+        self._ranker = (
+            BatchedRanker(self._predictor, self.spec_frames)
+            if self._predictor is not None else None
+        )
         # Aggregate counters (per-slot views go through labeled metrics).
         self.ticks_total = 0
         self.device_dispatches_total = 0
@@ -339,6 +358,9 @@ class BatchedSessionCore:
         # so the ROADMAP's native-argument-assembly item has a baseline.
         self.last_branch_build_ms = 0.0
         self.last_arg_assembly_ms = 0.0
+        self.last_predictor_rank_ms = 0.0
+        self.predictor_rank_ms_total = 0.0
+        self.predictor_rank_dispatches = 0
 
     # -- lifecycle ------------------------------------------------------
 
@@ -360,6 +382,8 @@ class BatchedSessionCore:
         self.rings, self.states = self._exec.admit(
             self.rings, self.states, 0, row(self.rings), row(self.states)
         )
+        if self._ranker is not None:
+            self._ranker.warmup(self.num_slots, self.num_players)
 
     def admit(
         self,
@@ -427,6 +451,10 @@ class BatchedSessionCore:
             self.input_spec, self.num_players, self.num_branches,
             self.spec_frames, self._branch_values, s.input_log,
         )
+        if self._predictor is not None:
+            # The borrowed _structured_bits picks this up via getattr;
+            # per-dispatch seeds land in _seed_memo (see _dispatch).
+            s.shim._predictor = self._predictor
         self.metrics.count(
             "matches_admitted" if ticket is None else "matches_readmitted"
         )
@@ -549,11 +577,15 @@ class BatchedSessionCore:
             for slot, t, frame, session in rows:
                 session.report_checksum(frame, combine64(cs_host[slot, t]))
 
-    def _build_branches(self, s: _Slot, anchor: int, end: int, session):
+    def _build_branches(self, s: _Slot, anchor: int, end: int, session,
+                        seed=None):
         """The next rollout's branch tensor for one slot — the singleton
         builder, verbatim (native when available, else the borrowed
-        structured tree)."""
+        structured tree). ``seed`` is this slot's slice of the batched
+        predictor ranking (None when the predictor is off)."""
         if s.native is not None:
+            if seed is not None:
+                s.native.seed(anchor, seed)
             qs_ptr = s.native.qset_ptr(session)
             if qs_ptr is not None:
                 known = known_mask = None
@@ -572,6 +604,10 @@ class BatchedSessionCore:
             known, known_mask = self._known0, self._mask0
         else:
             known, known_mask = s.shim._known_inputs(anchor, session)
+        if getattr(s.shim, "_predictor", None) is not None:
+            # Fresh per-call memo: a stale one (same anchor, pre-burst
+            # window) must never leak into this build.
+            s.shim._seed_memo = (anchor, seed) if seed is not None else None
         return s.shim._structured_bits(
             np.asarray(last), known, known_mask, anchor
         )
@@ -610,6 +646,48 @@ class BatchedSessionCore:
         measure = self._measure_host
         t_loop = time.perf_counter() if measure else 0.0
         bb_ms = 0.0
+        rank_ms = 0.0
+        # Pass 1 — as-used log writes + anchor geometry for every batched
+        # slot, hoisted ahead of the build loop so the batched predictor
+        # ranking sees all post-write windows in ONE vmapped call.
+        geom: Dict[int, tuple] = {}
+        for i, (load_frame, steps, confirmed, _session) in batch.items():
+            s = self.slots[i]
+            start = s.frame if load_frame is None else load_frame
+            end = start + len(steps)
+            anchor = end if confirmed is None else confirmed + 1
+            # As-used log BEFORE match/build (forward-fill reads anchor-1,
+            # which this very burst may advance).
+            for t, st in enumerate(steps):
+                s.input_log[start + t] = np.asarray(st.adv.bits)
+            spec_active = (
+                s.spec_on and anchor <= end and anchor > end - self.ring_depth
+            )
+            geom[i] = (start, end, anchor, spec_active)
+        seeds: Dict[int, object] = {}
+        if self._ranker is not None:
+            eligible = [i for i in batch if geom[i][3]]
+            if eligible:
+                t_rank = time.perf_counter()
+                W = self._predictor.weights.window
+                wins = np.full((S, W, P), -1, dtype=np.int32)
+                anchors = np.zeros(S, dtype=np.int32)
+                for i in eligible:
+                    anchors[i] = geom[i][2]
+                    wins[i] = self._predictor.window_indices(
+                        self.slots[i].input_log, geom[i][2], P
+                    )
+                traj_idx, order = self._ranker.rank(wins, anchors)
+                for i in eligible:
+                    seeds[i] = self._predictor.render_seed(
+                        traj_idx[i], order[i]
+                    )
+                rank_ms = (time.perf_counter() - t_rank) * 1000.0
+                self.last_predictor_rank_ms = rank_ms
+                self.predictor_rank_ms_total += rank_ms
+                self.predictor_rank_dispatches += 1
+                self.metrics.observe("predictor_rank_ms", rank_ms)
+                self.timeseries.observe("predictor_rank_ms", rank_ms)
         for s in self.slots:
             i = s.index
             if i not in batch:
@@ -625,14 +703,8 @@ class BatchedSessionCore:
                 continue
             requests_seg = batch[i]
             load_frame, steps, confirmed, session = requests_seg
-            start = s.frame if load_frame is None else load_frame
+            start, end, anchor, spec_active = geom[i]
             n_steps = len(steps)
-            end = start + n_steps
-            anchor = end if confirmed is None else confirmed + 1
-            # As-used log BEFORE match/build (forward-fill reads anchor-1,
-            # which this very burst may advance).
-            for t, st in enumerate(steps):
-                s.input_log[start + t] = np.asarray(st.adv.bits)
             # Branch-commit decision (host-side, zero device syncs).
             absorb_branch, n_commit = 0, 0
             missed = False
@@ -691,18 +763,20 @@ class BatchedSessionCore:
                                 blame_player = div[1]
                                 blame_frame = load_frame + div[0]
             # The next rollout. Speculation is active only when the anchor
-            # lies inside the post-burst ring window; otherwise the lane
-            # still computes a (discarded) rollout from the live frontier.
-            spec_active = (
-                s.spec_on and anchor <= end and anchor > end - self.ring_depth
-            )
+            # lies inside the post-burst ring window (precomputed in pass
+            # 1); otherwise the lane still computes a (discarded) rollout
+            # from the live frontier.
             if spec_active:
                 if measure:
                     t_bb = time.perf_counter()
-                    bb = self._build_branches(s, anchor, end, session)
+                    bb = self._build_branches(
+                        s, anchor, end, session, seeds.get(i)
+                    )
                     bb_ms += (time.perf_counter() - t_bb) * 1000.0
                 else:
-                    bb = self._build_branches(s, anchor, end, session)
+                    bb = self._build_branches(
+                        s, anchor, end, session, seeds.get(i)
+                    )
                 spec_anchor, from_live = anchor, (anchor == end)
             else:
                 bb = self._zero_bb
@@ -744,7 +818,7 @@ class BatchedSessionCore:
             # Everything in the loop that is not the branch build is
             # argument assembly (log writes, match, per-slot array fills).
             loop_ms = (time.perf_counter() - t_loop) * 1000.0
-            arg_ms = max(0.0, loop_ms - bb_ms)
+            arg_ms = max(0.0, loop_ms - bb_ms - rank_ms)
             self.last_branch_build_ms = bb_ms
             self.last_arg_assembly_ms = arg_ms
             self.metrics.observe("serve_branch_build", bb_ms)

@@ -71,6 +71,11 @@ class SessionBuilder:
         self.seed = 0
         self.desync_detection = "auto"
         self.interaction_mode: Optional[str] = None
+        # Learned input-predictor config (predict/): None = consult
+        # GGRS_PREDICTOR at session start; False = force off. Resolved to
+        # a 64-bit weight-content-hash config digest the sync handshake
+        # advertises and enforces (see with_input_predictor).
+        self.input_predictor = None
         self._players: Dict[int, PlayerType] = {}
         self._spectators: List[object] = []
 
@@ -135,6 +140,37 @@ class SessionBuilder:
         neighbor.set_default_interaction_mode(mode)
         self.interaction_mode = mode
         return self
+
+    def with_input_predictor(self, predictor) -> "SessionBuilder":
+        """Configure the learned on-device input predictor
+        (:mod:`bevy_ggrs_tpu.predict`) for sessions this builder starts.
+
+        ``predictor``: ``True``/``"default"`` for the committed default
+        artifact, an artifact path, :class:`PredictorWeights`, an
+        :class:`InputPredictor`, ``False`` to force prediction off
+        (ignoring ``GGRS_PREDICTOR``), or ``None`` (the default) to
+        consult the ``GGRS_PREDICTOR`` env var at session start.
+
+        Determinism contract: the resolved weights' 64-bit content hash
+        becomes the session's wire config digest — every sync-handshake
+        leg carries it, and a peer advertising a different digest is
+        REFUSED with a typed ``CONFIG_MISMATCH`` event (never a desync:
+        the handshake simply won't complete). The weights themselves are
+        validated here, at configuration time, so a bad path fails the
+        builder call instead of a session mid-start."""
+        from bevy_ggrs_tpu.predict import resolve_predictor_config
+
+        resolve_predictor_config(predictor)  # validate eagerly
+        self.input_predictor = predictor
+        return self
+
+    def _config_digest(self) -> int:
+        """The wire config digest for sessions started now: the resolved
+        predictor's weight content hash, 0 when prediction is off."""
+        from bevy_ggrs_tpu.predict import resolve_predictor_config
+
+        ip = resolve_predictor_config(self.input_predictor)
+        return 0 if ip is None else ip.content_hash
 
     def with_desync_detection(self, interval_frames) -> "SessionBuilder":
         """Configure the P2P checksum exchange (the ggrs
@@ -207,6 +243,7 @@ class SessionBuilder:
             desync_detection=self.desync_detection,
             metrics=metrics,
             tracer=tracer,
+            config_digest=self._config_digest(),
         )
 
     def start_synctest_session(self) -> SyncTestSession:
@@ -230,4 +267,5 @@ class SessionBuilder:
             max_frames_behind=self.max_frames_behind,
             seed=self.seed,
             clock=clock,
+            config_digest=self._config_digest(),
         )

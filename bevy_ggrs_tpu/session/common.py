@@ -72,6 +72,14 @@ class EventKind(enum.Enum):
     # magic but a different protocol version — without this, mixed-version
     # peers hang in SYNCHRONIZING forever with no operator-visible signal.
     VERSION_MISMATCH = "version_mismatch"  # data: (peer_version, count)
+    # Extension: the peer speaks our protocol version but advertises a
+    # different 64-bit session-config digest in the sync handshake (v4:
+    # the learned input-predictor weight hash, 0 = off). The handshake is
+    # refused — the peer stays SYNCHRONIZING, never RUNNING — because
+    # playing on with silently different prediction configs is an
+    # operational lie even though confirmed-input determinism would hold.
+    # data: (local_digest, peer_digest, count)
+    CONFIG_MISMATCH = "config_mismatch"
     # Extension: speculation-safety attestation failed at warmup — the
     # vmapped rollout and serial burst disagreed bitwise for this model, so
     # speculative recovery was auto-disabled (serial path stays correct).

@@ -45,6 +45,11 @@ DEFAULT_DISCONNECT_NOTIFY_START = 0.5
 # (small enough to trigger well inside the sync retry window, large enough
 # that one stray/spoofed datagram doesn't raise a false alarm).
 VERSION_MISMATCH_THRESHOLD = 5
+# Mismatched config digests (SyncRequest/SyncReply, v4) before a
+# CONFIG_MISMATCH event fires. Lower than the version threshold: these
+# arrive inside well-formed same-version handshake datagrams, so two
+# consistent sightings already rule out a stray spoof.
+CONFIG_MISMATCH_THRESHOLD = 2
 # Max frames per InputMsg: keeps the wire span well under the uint16 field
 # and one MTU even for late-joining spectators catching up on long history.
 MAX_INPUT_SPAN = 120
@@ -64,8 +69,13 @@ class PeerEndpoint:
         disconnect_timeout: float = DEFAULT_DISCONNECT_TIMEOUT,
         disconnect_notify_start: float = DEFAULT_DISCONNECT_NOTIFY_START,
         metrics=None,
+        config_digest: int = 0,
     ):
         self.addr = addr
+        # Session-config digest advertised in (and checked against) every
+        # sync handshake leg: the input-predictor weight content hash, 0 =
+        # prediction off. See on_message for the refusal semantics.
+        self.config_digest = int(config_digest) & 0xFFFFFFFFFFFFFFFF
         self.state = PeerState.SYNCHRONIZING
         self.metrics = metrics if metrics is not None else null_metrics
         self._rng = rng
@@ -119,6 +129,9 @@ class PeerEndpoint:
         # Version-skew accounting (the datagrams themselves are dropped).
         self.version_mismatches = 0
         self._version_mismatch_reported = False
+        # Config-digest skew accounting (handshake legs refused, typed).
+        self.config_mismatches = 0
+        self._config_mismatch_reported = False
 
     # ------------------------------------------------------------------
 
@@ -151,7 +164,10 @@ class PeerEndpoint:
                 if self._last_sync_sent > -1e9:
                     self._sync_failures += 1  # previous request went unanswered
                 self._sync_nonce = int(self._rng.randint(0, 2**31))
-                self._send(proto.SyncRequest(self._sync_nonce), now)
+                self._send(
+                    proto.SyncRequest(self._sync_nonce, self.config_digest),
+                    now,
+                )
                 self._last_sync_sent = now
             return
         if self.state == PeerState.DISCONNECTED:
@@ -193,8 +209,19 @@ class PeerEndpoint:
             self._emit(EventKind.NETWORK_RESUMED)
 
         if isinstance(msg, proto.SyncRequest):
-            self._send(proto.SyncReply(msg.nonce), now)
+            # Typed refusal on config skew: no reply — the mismatched
+            # peer's handshake can never complete against us (and ours
+            # never completes against it, see the SyncReply leg), so
+            # neither side reaches RUNNING with divergent predictor
+            # weights. The event names both digests for the operator.
+            if msg.config_digest != self.config_digest:
+                self.note_config_mismatch(msg.config_digest)
+                return
+            self._send(proto.SyncReply(msg.nonce, self.config_digest), now)
         elif isinstance(msg, proto.SyncReply):
+            if msg.config_digest != self.config_digest:
+                self.note_config_mismatch(msg.config_digest)
+                return
             if (
                 self.state == PeerState.SYNCHRONIZING
                 and msg.nonce == self._sync_nonce
@@ -293,6 +320,29 @@ class PeerEndpoint:
                     "peer_version": peer_version,
                     "local_version": proto.VERSION,
                     "count": self.version_mismatches,
+                },
+            )
+
+    def note_config_mismatch(self, peer_digest: int) -> None:
+        """Count a refused handshake leg whose config digest disagreed
+        with ours; after CONFIG_MISMATCH_THRESHOLD of them, emit one
+        CONFIG_MISMATCH event. Unlike version skew there is no progress
+        gate: mismatched digests arrive in datagrams we fully parsed at
+        our own protocol version, and the refusal itself is what keeps
+        the peer stalled — the operator needs the signal immediately."""
+        self.config_mismatches += 1
+        self.metrics.count("config_mismatch_datagrams")
+        if (
+            not self._config_mismatch_reported
+            and self.config_mismatches >= CONFIG_MISMATCH_THRESHOLD
+        ):
+            self._config_mismatch_reported = True
+            self._emit(
+                EventKind.CONFIG_MISMATCH,
+                data={
+                    "local_digest": self.config_digest,
+                    "peer_digest": int(peer_digest) & 0xFFFFFFFFFFFFFFFF,
+                    "count": self.config_mismatches,
                 },
             )
 

@@ -93,6 +93,7 @@ class P2PSession:
         desync_detection="auto",
         metrics=None,
         tracer=None,
+        config_digest: int = 0,
     ):
         self.num_players = int(num_players)
         self.input_spec = input_spec
@@ -138,6 +139,10 @@ class P2PSession:
         self._rng = rng
         self._disconnect_timeout = disconnect_timeout
         self._disconnect_notify_start = disconnect_notify_start
+        # Session-config digest every endpoint advertises/enforces in the
+        # sync handshake (v4): the input-predictor weight content hash, 0
+        # when prediction is off (SessionBuilder.with_input_predictor).
+        self.config_digest = int(config_digest) & 0xFFFFFFFFFFFFFFFF
         self._endpoints: Dict[object, PeerEndpoint] = {}
         for addr in set(remote_players.values()) | set(spectators):
             self._endpoints[addr] = PeerEndpoint(
@@ -146,6 +151,7 @@ class P2PSession:
                 disconnect_timeout=disconnect_timeout,
                 disconnect_notify_start=disconnect_notify_start,
                 metrics=self.metrics,
+                config_digest=self.config_digest,
             )
         self._spectator_addrs = list(spectators)
         # Confirmed-input fan-out cursor per spectator address.
@@ -338,6 +344,7 @@ class P2PSession:
             disconnect_timeout=self._disconnect_timeout,
             disconnect_notify_start=self._disconnect_notify_start,
             metrics=self.metrics,
+            config_digest=self.config_digest,
         )
         fresh.reconnecting = True
         self._endpoints[addr] = fresh

@@ -31,7 +31,14 @@ MAGIC = 0x47  # 'G'
 # VERSION_MISMATCH instead of firing a false DESYNC_DETECTED on the first
 # compared resource-bearing frame. Checksum semantics are part of the wire
 # contract this version gates.
-VERSION = 3
+# v4: SyncRequest/SyncReply carry a 64-bit config digest (the learned
+# input-predictor's weight content hash, 0 = predictor off). Prediction
+# only shapes each peer's LOCAL speculation tree — committed states come
+# from confirmed inputs either way — but the digest makes the deployed
+# prediction config attestable at handshake time: a peer running different
+# weights is refused with a typed CONFIG_MISMATCH event instead of playing
+# on with silently different recovery economics.
+VERSION = 4
 
 T_SYNC_REQUEST = 1
 T_SYNC_REPLY = 2
@@ -80,11 +87,17 @@ _HDR = struct.Struct("<BBB")  # magic, version, type
 @dataclasses.dataclass(frozen=True)
 class SyncRequest:
     nonce: int
+    # 64-bit session-config digest (v4): the input-predictor weight
+    # content hash, or 0 when prediction is off. Checked on BOTH legs of
+    # the handshake (see PeerEndpoint) — a mismatched peer never reaches
+    # RUNNING.
+    config_digest: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
 class SyncReply:
     nonce: int
+    config_digest: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -351,6 +364,7 @@ Message = Union[
 ]
 
 _U32 = struct.Struct("<I")
+_SYNC = struct.Struct("<IQ")  # nonce, config_digest
 _I32U64 = struct.Struct("<iQ")
 _BI = struct.Struct("<Bi")
 _IH = struct.Struct("<Ih")
@@ -374,9 +388,13 @@ _FLEET_HB = struct.Struct(
 
 def encode(msg: Message) -> bytes:
     if isinstance(msg, SyncRequest):
-        return _HDR.pack(MAGIC, VERSION, T_SYNC_REQUEST) + _U32.pack(msg.nonce)
+        return _HDR.pack(MAGIC, VERSION, T_SYNC_REQUEST) + _SYNC.pack(
+            msg.nonce, msg.config_digest & 0xFFFFFFFFFFFFFFFF
+        )
     if isinstance(msg, SyncReply):
-        return _HDR.pack(MAGIC, VERSION, T_SYNC_REPLY) + _U32.pack(msg.nonce)
+        return _HDR.pack(MAGIC, VERSION, T_SYNC_REPLY) + _SYNC.pack(
+            msg.nonce, msg.config_digest & 0xFFFFFFFFFFFFFFFF
+        )
     if isinstance(msg, InputMsg):
         return _HDR.pack(MAGIC, VERSION, T_INPUT) + msg.encode()
     if isinstance(msg, InputAck):
@@ -507,9 +525,11 @@ def decode(data: bytes) -> Optional[Message]:
             return None
         body = data[_HDR.size :]
         if mtype == T_SYNC_REQUEST:
-            return SyncRequest(_U32.unpack_from(body)[0])
+            nonce, digest = _SYNC.unpack_from(body)
+            return SyncRequest(nonce, digest)
         if mtype == T_SYNC_REPLY:
-            return SyncReply(_U32.unpack_from(body)[0])
+            nonce, digest = _SYNC.unpack_from(body)
+            return SyncReply(nonce, digest)
         if mtype == T_INPUT:
             return InputMsg.decode(body)
         if mtype == T_INPUT_ACK:

@@ -56,6 +56,7 @@ class SpectatorSession:
         max_frames_behind: int = 4,
         seed: int = 0,
         clock=None,
+        config_digest: int = 0,
     ):
         self.num_players = int(num_players)
         self.input_spec = input_spec
@@ -69,7 +70,9 @@ class SpectatorSession:
         self._qset = make_queue_set(self._zero, [0] * num_players)
         self._queues = self._qset.queues
         rng = np.random.RandomState(seed)
-        self._endpoint = PeerEndpoint(host_addr, rng)
+        self._endpoint = PeerEndpoint(
+            host_addr, rng, config_digest=config_digest
+        )
         self.current_frame = 0
         self._events: List[SessionEvent] = []
         # Per-handle streak of consecutive POLLS whose input messages for

@@ -40,6 +40,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
+import time
 import zlib
 from typing import List, Optional
 
@@ -50,6 +51,7 @@ import numpy as np
 from bevy_ggrs_tpu.fused import FusedTickExecutor, absorb_branch_frames
 from bevy_ggrs_tpu.native import spec as native_spec
 from bevy_ggrs_tpu.obs.ledger import blame_divergence
+from bevy_ggrs_tpu.predict.model import resolve_predictor
 from bevy_ggrs_tpu.parallel.speculate import (
     SpecResult,
     SpeculativeExecutor,
@@ -335,6 +337,13 @@ def _attestation_key(runner: "SpeculativeRollbackRunner"):
             runner.executor.max_frames,
             runner.ring.depth,
             tuple(np.asarray(v).tobytes() for v in runner._branch_values),
+            # Predictor-seeded trees enumerate from a different base and
+            # candidate order than heuristic trees — a predictor-ON
+            # verdict is keyed by the exact weights it attested with.
+            (
+                None if getattr(runner, "_predictor", None) is None
+                else runner._predictor.content_hash
+            ),
             mesh_fp,
         )
     except Exception:  # noqa: BLE001 — any unkeyable shape degrades to miss
@@ -625,6 +634,7 @@ class SpeculativeRollbackRunner(RollbackRunner):
         mesh=None,
         entity_axis: str = "entity",
         branch_axis: str = "branch",
+        predictor=None,
         **kwargs,
     ):
         if mesh is not None:
@@ -740,6 +750,26 @@ class SpeculativeRollbackRunner(RollbackRunner):
             native_spec.MirroredLog(self._native)
             if self._native is not None else {}
         )
+        # Learned input predictor (predict/): bound to this session's
+        # candidate universe when the weights apply (scalar payload,
+        # universe within the trained value slots), else None and the
+        # structured tree keeps its heuristic ranking. ``predictor=None``
+        # consults GGRS_PREDICTOR (off by default); a custom sampler
+        # bypasses the structured builder entirely, so it forces the
+        # predictor off too. The seed memo carries one anchor's seed from
+        # the signature fold to the tree build inside a single tick.
+        shape = tuple(getattr(input_spec, "shape", ()) or ())
+        n_field = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        self._predictor = (
+            resolve_predictor(
+                predictor, self._branch_values,
+                input_spec.zeros_np(1).dtype, n_field,
+            )
+            if sampler is None else None
+        )
+        self._seed_memo = None
+        self.predictor_rank_ms_total = 0.0
+        self.predictor_rank_builds = 0
         # Deferred checksum reports: (device_cs_array, [(row, frame)]).
         # The fused tick never blocks on its own outputs — wanted
         # checksums are read at the START of the next tick, by which time
@@ -752,6 +782,25 @@ class SpeculativeRollbackRunner(RollbackRunner):
         self.spec_misses = 0
         self.rollback_frames_recovered_total = 0
 
+    def _predictor_seed(self, anchor: int):
+        """The predictor's branch-tree seed for ``anchor`` (None when no
+        predictor is bound). Always recomputed from the CURRENT input log
+        — corrections may rewrite window frames between ticks — and
+        memoized so the two consumers inside one tick (the dedup
+        signature and :meth:`_structured_bits`) share one rollout."""
+        if self._predictor is None:
+            return None
+        t0 = time.perf_counter()
+        seed = self._predictor.seed(
+            self._input_log, anchor, self.spec_frames, self.num_players
+        )
+        ms = (time.perf_counter() - t0) * 1e3
+        self.predictor_rank_ms_total += ms
+        self.predictor_rank_builds += 1
+        self.metrics.observe("predictor_rank_ms", ms)
+        self._seed_memo = (anchor, seed)
+        return seed
+
     def invalidate_speculation(self) -> None:
         """Drop every speculative transient: the pending rollout, its
         dedup signature, and the as-used input log. MUST be called when
@@ -762,6 +811,7 @@ class SpeculativeRollbackRunner(RollbackRunner):
         self._result = None
         self._spec_sig = None
         self._ledger_note = None
+        self._seed_memo = None
         self._input_log.clear()
         # Reports computed from the pre-restore world must not surface
         # into the post-restore session.
@@ -1006,6 +1056,10 @@ class SpeculativeRollbackRunner(RollbackRunner):
             else:
                 with self.metrics.timer("known_inputs_query"):
                     known, known_mask = self._known_inputs(anchor, session)
+            if self._predictor is not None:
+                # Seed folds into the native dedup signature (and, when
+                # not deduplicated, replaces base + candidate ranking).
+                self._native.seed(anchor, self._predictor_seed(anchor))
             with self.metrics.timer("structured_bits_build"):
                 bits, sig = self._native.build(
                     anchor, qs_ptr, known, known_mask, allow_skip,
@@ -1024,11 +1078,13 @@ class SpeculativeRollbackRunner(RollbackRunner):
                 last = self.input_spec.zeros_np(self.num_players)
             with self.metrics.timer("known_inputs_query"):
                 known, known_mask = self._known_inputs(anchor, session)
+            pseed = self._predictor_seed(anchor)
             if anchor < end and self._sampler is None:
                 sig = (
                     anchor, np.asarray(last).tobytes(),
                     known.tobytes(), known_mask.tobytes(),
                     self._history_fingerprint(anchor),
+                    b"" if pseed is None else pseed.fold_bytes(),
                 )
                 # Dedup-skip STEADY ticks only: a rollback tick already ran
                 # (and charged) the branch match above — delegating it to
@@ -1218,6 +1274,10 @@ class SpeculativeRollbackRunner(RollbackRunner):
             else:
                 with self.metrics.timer("known_inputs_query"):
                     known, known_mask = self._known_inputs(anchor, session)
+            if self._predictor is not None:
+                # Seed folds into the native dedup signature (and, when
+                # not deduplicated, replaces base + candidate ranking).
+                self._native.seed(anchor, self._predictor_seed(anchor))
             with self.metrics.timer("structured_bits_build"):
                 bits, sig = self._native.build(
                     anchor, qs_ptr, known, known_mask, allow_skip,
@@ -1238,6 +1298,7 @@ class SpeculativeRollbackRunner(RollbackRunner):
             last = self.input_spec.zeros_np(self.num_players)
         with self.metrics.timer("known_inputs_query"):
             known, known_mask = self._known_inputs(anchor, session)
+        pseed = self._predictor_seed(anchor)
         if anchor < self.frame and self._sampler is None:
             # The anchor state is ring-fixed (a past frame) and the
             # structured tree is deterministic in (anchor, last, known)
@@ -1253,6 +1314,7 @@ class SpeculativeRollbackRunner(RollbackRunner):
                 anchor, np.asarray(last).tobytes(),
                 known.tobytes(), known_mask.tobytes(),
                 self._history_fingerprint(anchor),
+                b"" if pseed is None else pseed.fold_bytes(),
             )
             if self._result is not None and sig == self._spec_sig:
                 self.spec_dispatches_skipped += 1
@@ -1634,7 +1696,33 @@ class SpeculativeRollbackRunner(RollbackRunner):
         # one player DEVIATING from the pattern. Branch 0 stays the
         # session's literal forward-fill prediction (the engine must
         # strictly contain the reference's repeat-last policy).
-        pred = self._extrapolate_base(base, known, known_mask, anchor)
+        # A bound learned predictor (predict/) replaces BOTH the
+        # periodic extrapolator (its autoregressive trajectory becomes
+        # the effective base) and the recency/toggle candidate ranking
+        # (its first-step logits order the universe). Accessed via
+        # getattr so the borrowed-method hosts (_ReplayBuilder,
+        # _SlotSpecShim) opt in by simply setting `_predictor`.
+        # Branch 0 below stays the literal forward-fill prediction
+        # regardless — recovery is never worse than repeat-last.
+        seeded = None
+        predictor = getattr(self, "_predictor", None)
+        if predictor is not None:
+            memo = getattr(self, "_seed_memo", None)
+            if memo is not None and memo[0] == anchor:
+                seeded = memo[1]  # same tick's signature-fold seed
+            else:
+                seeded = predictor.seed(self._input_log, anchor, F, P)
+        if seeded is not None:
+            knownf = np.asarray(known).reshape(F, P, -1)
+            trajf = seeded.traj.reshape(F, P, -1).astype(
+                base.dtype, copy=True
+            )
+            trajf = np.where(known_mask[:, :, None], knownf, trajf)
+            pred = trajf.reshape(base.shape)
+            if np.array_equal(pred, base):
+                pred = None
+        else:
+            pred = self._extrapolate_base(base, known, known_mask, anchor)
         eff_base = base if pred is None else pred
         out = np.broadcast_to(eff_base, (B, F, P) + shape).copy()
         out[0] = base
@@ -1648,7 +1736,10 @@ class SpeculativeRollbackRunner(RollbackRunner):
         # differs from the base prediction; flattening E in C order gives
         # the rank-major enumeration, and the first B-start_b eligible
         # entries become branches start_b..B-1.
-        C, cvalid = self._candidate_values(last)  # [P, K, R]
+        if seeded is not None:
+            C, cvalid = seeded.cand, seeded.valid  # [P, K, R]
+        else:
+            C, cvalid = self._candidate_values(last)  # [P, K, R]
         n_field = C.shape[1]
         basef = eff_base.reshape(F, P, n_field)
         free = ~known_mask  # [F, P]

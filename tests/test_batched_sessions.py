@@ -93,13 +93,13 @@ def make_core(num_slots=4, **kw):
     return core
 
 
-def make_singleton(spec=True):
+def make_singleton(spec=True, **kw):
     if spec:
         r = SpeculativeRollbackRunner(
             box_game.make_schedule(), box_game.make_world(P).commit(),
             max_prediction=MAXPRED, num_players=P,
             input_spec=box_game.INPUT_SPEC,
-            num_branches=BRANCHES, spec_frames=SPEC_FRAMES,
+            num_branches=BRANCHES, spec_frames=SPEC_FRAMES, **kw,
         )
     else:
         r = RollbackRunner(
@@ -165,8 +165,13 @@ def test_parity_spec_branches_commit():
     """A script shaped for the structured tree (one player deviates, the
     other holds) must produce speculative commits in the batch AND stay
     bitwise-equal to the singleton — state parity must hold through the
-    absorb path, not just the serial-burst path."""
-    core = make_core(num_slots=2)
+    absorb path, not just the serial-burst path.
+
+    Pinned predictor-OFF: the deviation below was crafted to land inside
+    the HEURISTIC ranking's branch budget, which a learned ranking is
+    free to order differently (predictor-ON absorb coverage lives in
+    tests/test_predictor.py's session suite)."""
+    core = make_core(num_slots=2, predictor=False)
     slot = core.admit()
     script = [(step_requests(f, [f % 4, (f + 1) % 4]), f) for f in range(3)]
     script.append((step_requests(3, [2, 3]), 2))
@@ -176,7 +181,7 @@ def test_parity_spec_branches_commit():
     script.append((reqs, 5))
     drive(core, {slot: script})
     assert core.spec_hits >= 1  # the absorb path actually exercised
-    spec = make_singleton(spec=True)
+    spec = make_singleton(spec=True, predictor=False)
     for r, confirmed in script:
         spec.tick(r, confirmed, None)
     assert_slot_equals_runner(core, slot, spec)

@@ -46,6 +46,31 @@ from typing import Dict, List, Optional
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+_REPEAT_LAST_FLOOR: Optional[float] = None
+
+
+def repeat_last_floor() -> float:
+    """The committed repeat-last full-hit floor from spec_baseline.json:
+    the highest full_hit_rate the repeat_last policy achieves on any
+    replay config. A predictor-ON row (spec_policy == "learned") scoring
+    below this floor means the learned ranking made speculation WORSE
+    than the zero-parameter baseline — a hard failure regardless of
+    latency. Missing/malformed baseline degrades to 0.0 (the > 0 health
+    check still applies)."""
+    global _REPEAT_LAST_FLOOR
+    if _REPEAT_LAST_FLOOR is None:
+        floor = 0.0
+        try:
+            with open(os.path.join(REPO_ROOT, "spec_baseline.json")) as f:
+                base = json.load(f)
+            for cfg in base.get("configs", {}).values():
+                rl = cfg.get("policies", {}).get("repeat_last", {})
+                floor = max(floor, float(rl.get("full_hit_rate", 0.0)))
+        except (OSError, ValueError):
+            floor = 0.0
+        _REPEAT_LAST_FLOOR = floor
+    return _REPEAT_LAST_FLOOR
+
 
 def load_rows(path: str) -> List[dict]:
     """Bench rows from any artifact shape this repo produces: a single
@@ -181,6 +206,34 @@ def check_row(row: dict, base: Optional[dict],
                        detail="spec_full_hit_rate == 0 on a *_spec_on* row "
                               "(speculation path silently dead)")
             return out
+        # Learned-predictor columns (predict/, bench._predictor_columns):
+        # spec_policy names the candidate-ranking policy that seeded the
+        # branch trees; predictor_rank_ms is the mean host cost of one
+        # ranking pass (0.0 when the predictor is off).
+        if row.get("spec_policy") not in ("current", "learned"):
+            out.update(status="FAIL",
+                       detail="spec row lost its spec_policy column "
+                              f"(got {row.get('spec_policy')!r})")
+            return out
+        if not isinstance(row.get("predictor_rank_ms"), (int, float)):
+            out.update(status="FAIL",
+                       detail="spec row lost its predictor_rank_ms column")
+            return out
+        if (
+            row.get("spec_policy") == "learned"
+            and "_spec_on" in metric
+            and row.get("spec_full_hit_rate") < repeat_last_floor()
+        ):
+            out.update(
+                status="FAIL",
+                detail=f"predictor-ON row full-hit rate "
+                       f"{row.get('spec_full_hit_rate')!r} is below the "
+                       f"committed repeat-last floor "
+                       f"{repeat_last_floor():.4f} (learned ranking made "
+                       "speculation worse than the zero-parameter "
+                       "baseline)",
+            )
+            return out
     if base is None:
         out.update(status="skipped", detail="no committed baseline row")
         return out
@@ -189,6 +242,24 @@ def check_row(row: dict, base: Optional[dict],
         out.update(
             status="skipped",
             detail=f"platform mismatch (baseline {bplat}, current {cplat}); "
+                   "health checks only",
+        )
+        return out
+    # Policy honesty, same shape as platform honesty: a predictor-ON row
+    # pays the ranking pass on the tick path, so its latency is only
+    # comparable against a baseline ranked by the same policy.
+    # Baselines committed before the column existed were all produced
+    # with the heuristic ranking, so a missing spec_policy reads as
+    # "current"; rows that legitimately have no policy (non-spec rows on
+    # both sides) compare as equal Nones.
+    bpol = base.get("spec_policy") or (
+        "current" if row.get("spec_policy") is not None else None
+    )
+    cpol = row.get("spec_policy")
+    if bpol is not None and cpol is not None and bpol != cpol:
+        out.update(
+            status="skipped",
+            detail=f"spec_policy mismatch (baseline {bpol}, current {cpol}); "
                    "health checks only",
         )
         return out
