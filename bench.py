@@ -2768,6 +2768,291 @@ def _front_door_case(S: int) -> dict:
     )
 
 
+_AUTOSCALE_CONFIGS = {"fleet_autoscale_N3": 3}
+
+
+def _fleet_autoscale_case(N: int) -> dict:
+    """One full elasticity arc on the SUBPROCESS fleet (fleet/proc.py)
+    under the autopilot policy (fleet/autopilot.py): traffic pushes
+    occupancy over the high watermark -> policy spawns server N-1 (the
+    measured scale-up latency is spawn -> first heartbeat, i.e. a whole
+    JAX runtime boot warmed from the shared XLA disk cache); an armed
+    burn window on one child pages its SLO -> the policy evacuates its
+    matches over the type-18-21 wire BEFORE the watchdog fences
+    (preemption lead = first observed page -> migration landed, with the
+    donor still at zero fences/quarantines); a traffic drop crosses the
+    low watermark -> drain-pack-retire (the packing stalls are the
+    drain-pack migration stall frames). Gated on matches_lost == 0 and
+    fleet-wide churn_recompiles == 0 after steady state — every
+    migration must land in the destination's warm jit cache."""
+    import shutil
+    import tempfile
+
+    from bevy_ggrs_tpu.fleet.autopilot import (
+        AutopilotConfig,
+        FleetAutopilot,
+        verify_ledger,
+    )
+    from bevy_ggrs_tpu.fleet.proc import ProcFleet
+    from bevy_ggrs_tpu.fleet.traffic import TrafficPlan
+
+    base = {
+        "fps": 0,  # free-run: arc wall time is compute-bound, not paced
+        "heartbeat_interval": 8,
+        "status_interval": 20,
+        "checkpoint_interval": 40,
+    }
+    rtt0 = _host_device_rtt_ms()
+    root = tempfile.mkdtemp(prefix="ggrs_fleet_autoscale_")
+    td = _bench_trace_dir(f"fleet_autoscale_N{N}")
+    fleet = ProcFleet(
+        root, base_config=base, heartbeat_timeout=8.0, obs_dir=td
+    )
+    cfg = AutopilotConfig(
+        high_watermark=0.8, low_watermark=0.3, confirm_beats=3,
+        preempt_confirm=2, preempt_batch=1, cooldown_scale_ticks=40,
+        cooldown_preempt_ticks=20, min_servers=2, max_servers=N + 1,
+    )
+    ap = FleetAutopilot(fleet, config=cfg)
+    tickbox = {"t": 0}
+
+    def tick():
+        ap.step(tickbox["t"])
+        tickbox["t"] += 1
+        for dead in fleet.check():
+            fleet.failover(dead, preferred=ap.backups)
+
+    def pump_until(pred, timeout, msg):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            fleet.pump()
+            tick()
+            if pred():
+                return
+            time.sleep(0.03)
+        raise SystemExit(f"fleet_autoscale: timed out waiting for {msg}")
+
+    def match_frames(sid):
+        st = fleet.members[sid].status or {}
+        return {int(k): v for k, v in st.get("matches", {}).items()}
+
+    try:
+        for _ in range(2):
+            fleet.spawn_server(wait_ready=True)
+
+        # Occupancy ramp: paced TrafficPlan arrivals over the high
+        # watermark; reconcile heartbeat-lagged bounces until every
+        # arrival genuinely serves somewhere.
+        plan = TrafficPlan.generate(
+            seed=23, duration=10.0, match_rate=3.0, num_players=2
+        )
+        arrivals = plan.arrivals()[:7]
+        t0 = time.time()
+        horizon = max(a.at for a in arrivals) or 1.0
+        pending = list(arrivals)
+        while pending:
+            fleet.pump()
+            tick()
+            elapsed = (time.time() - t0) * (horizon / 4.0)
+            while pending and pending[0].at <= elapsed:
+                fleet.admit(pending.pop(0).match_id)
+            time.sleep(0.03)
+
+        def all_admitted():
+            missing = [
+                a.match_id for a in arrivals
+                if a.match_id not in fleet.handles
+            ]
+            for mid in missing:
+                if mid not in fleet.book:
+                    fleet.admit(mid)
+            return not missing
+
+        pump_until(all_admitted, 60, "arrivals admitted")
+        pump_until(
+            lambda: len(fleet.samples()) == N, 240,
+            f"autopilot scale-up to N={N}",
+        )
+        new_sid = max(fleet.members)
+        scale_up_ms = [s * 1000.0 for s in fleet.scale_up_s]
+
+        # Steady state: warm the new server with real matches, then
+        # re-baseline every child's compile counter.
+        for mid in (100, 101):
+            fleet.admit(mid, new_sid)
+        pump_until(
+            lambda: match_frames(new_sid).get(100, 0) > 20, 120,
+            "new server serving",
+        )
+        for m in fleet.members.values():
+            m.process.send(cmd="rebase_compiles")
+
+        # Burn preemption: armed 1-in-3 deadline misses page the donor's
+        # SLO without ever fencing; measure first-page -> landed.
+        donor = 0
+        fleet.members[donor].process.send(
+            cmd="hiccup", every=3, ms=60.0, frames=400
+        )
+        paged_at = {}
+
+        def donor_paged():
+            if any(
+                rec["observation"]["servers"]
+                .get(str(donor), {}).get("pages", 0) >= 1
+                for rec in ap.ledger
+            ):
+                paged_at.setdefault("t", time.time())
+                return True
+            return False
+
+        pump_until(donor_paged, 120, "donor SLO paging")
+        stalls_before = len(fleet.stall_frames)
+        pump_until(
+            lambda: any(
+                e["event"] == "migrated" and e["src"] == donor
+                for e in fleet.events
+            ),
+            120, "burn-triggered preemptive migration",
+        )
+        preempt_latency_s = time.time() - paged_at["t"]
+        preempt_stalls = fleet.stall_frames[stalls_before:]
+        donor_info = fleet.members[donor].info
+        donor_status = fleet.members[donor].status or {}
+        preempt_landed_clean = bool(
+            donor_info.quarantined == 0
+            and donor_status.get("faults", 0) == 0
+            and donor_status.get("evictions", 0) == 0
+        )
+        pump_until(
+            lambda: fleet.members[donor].info.pages == 0, 180,
+            "pages clearing after burn window",
+        )
+
+        # Traffic drop: guarantee every member hosts >= 1 match so the
+        # drained member must PACK before retiring, then abandon the
+        # rest; the policy drain-pack-retires the emptiest member.
+        keep = {}
+        for mid, sid in sorted(fleet.placements().items()):
+            keep.setdefault(sid, mid)
+        for sid in sorted(fleet.samples()):
+            if sid not in keep:
+                fleet.admit(200 + sid, sid)
+                keep[sid] = 200 + sid
+        pump_until(
+            lambda: all(m in fleet.handles for m in keep.values()), 120,
+            "fill-in admissions serving",
+        )
+        for mid in sorted(fleet.placements()):
+            if mid not in keep.values():
+                fleet.retire_match(mid)
+        stalls_before = len(fleet.stall_frames)
+        pump_until(
+            lambda: any(e["event"] == "retired" for e in fleet.events),
+            240, "drain-pack-retire",
+        )
+        pack_stalls = fleet.stall_frames[stalls_before:]
+        victim = next(
+            e["server"] for e in fleet.events if e["event"] == "retired"
+        )
+        pump_until(
+            lambda: not fleet.members[victim].process.alive(), 60,
+            "retired child exiting",
+        )
+
+        # Fleet-wide churn gate: a fresh status from every survivor must
+        # report zero compiles since the steady-state rebase.
+        frames_before = {
+            sid: (m.status or {}).get("frames", 0)
+            for sid, m in fleet.members.items()
+            if m.process.alive()
+        }
+        pump_until(
+            lambda: all(
+                (fleet.members[sid].status or {}).get("frames", 0)
+                > frames_before[sid]
+                for sid in frames_before
+            ),
+            120, "fresh post-arc status",
+        )
+        churn_recompiles = sum(
+            (m.status or {}).get("compiles", 0)
+            for m in fleet.members.values()
+            if m.process.alive() and m.status is not None
+        )
+        frames_total = sum(
+            (m.status or {}).get("frames", 0)
+            for m in fleet.members.values()
+            if m.status is not None
+        )
+        ledger_path = os.path.join(root, "autopilot_ledger.jsonl")
+        ap.export_jsonl(ledger_path)
+        replay_ok, ledger_ticks = verify_ledger(ledger_path)
+        counts = dict(ap.counts)
+        row = _entry(
+            f"fleet_autoscale_N{N}",
+            float(np.percentile(scale_up_ms, 50)),
+            max(frames_total, 1), base.get("num_branches", 8),
+            rtt_ms=rtt0,
+            model="box_game",
+            servers=N,
+            scale_up_latency_p50_ms=round(
+                float(np.percentile(scale_up_ms, 50)), 1
+            ),
+            scale_up_latency_max_ms=round(max(scale_up_ms), 1),
+            scale_ups_measured=len(scale_up_ms),
+            preempt_latency_s=round(preempt_latency_s, 3),
+            preempt_landed_clean=preempt_landed_clean,
+            preempt_stall_frames=(
+                float(np.percentile(preempt_stalls, 50))
+                if preempt_stalls else None
+            ),
+            drain_pack_stall_p50_frames=float(
+                np.percentile(pack_stalls, 50)
+            ) if pack_stalls else 0.0,
+            drain_pack_stall_p99_frames=float(
+                np.percentile(pack_stalls, 99)
+            ) if pack_stalls else 0.0,
+            pack_migrations=len(pack_stalls),
+            migrations_completed=int(fleet.migrations_completed),
+            migrations_aborted=int(fleet.migrations_aborted),
+            matches_lost=int(fleet.matches_lost),
+            failovers=int(fleet.failovers),
+            churn_recompiles=int(churn_recompiles),
+            ledger_ticks=int(ledger_ticks),
+            ledger_replay_identical=bool(replay_ok),
+            decisions={k: int(v) for k, v in sorted(counts.items())},
+            notes=(
+                "subprocess fleet under the autopilot policy, one full "
+                "elasticity arc (scale-up at the high watermark, "
+                "burn-triggered preemptive evacuation landing with the "
+                "donor at zero fences, drain-pack-retire at the low "
+                "watermark); scale-up latency is spawn -> first UDP "
+                "heartbeat (a full child JAX boot off the shared XLA "
+                "disk cache); stalls are destination frames served "
+                "between wire offer and readmit; gated on matches_lost "
+                "== 0 and fleet-wide churn_recompiles == 0 (every "
+                "landing pre-traced by MatchServer.warmup's blob-codec "
+                "round-trip); the decision ledger replays identical "
+                "offline"
+            ),
+        )
+    finally:
+        fleet.close()
+        merged = None
+        if td is not None:
+            merged = fleet.merge_observability(
+                os.path.join(td, "fleet_autoscale_merged_trace.json")
+            )
+        shutil.rmtree(root, ignore_errors=True)
+    if merged is not None:
+        row["merged_trace_processes"] = len({
+            ev.get("pid")
+            for ev in merged.get("traceEvents", [])
+            if ev.get("ph") != "M"
+        })
+    return row
+
+
 # _cpuhost variants force the CPU backend (a LOCAL device): they
 # demonstrate the framework's host path meets the render deadline when
 # dispatch isn't tunnel-bound — the fair live reading for this
@@ -2813,6 +3098,8 @@ def run_config(name: str) -> dict:
         return _fleet_migrate_case(_FLEET_CONFIGS[name])
     if name in _FRONT_DOOR_CONFIGS:
         return _front_door_case(_FRONT_DOOR_CONFIGS[name])
+    if name in _AUTOSCALE_CONFIGS:
+        return _fleet_autoscale_case(_AUTOSCALE_CONFIGS[name])
     if name in _LIVE_CONFIGS:
         model, speculate, transport = _LIVE_CONFIGS[name]
         rtt0 = _host_device_rtt_ms()
@@ -2838,7 +3125,8 @@ def run_matrix() -> list:
                  + list(_LIVE_CONFIGS) + list(_EIGHTP_CONFIGS)
                  + list(_MULTIHOST_CONFIGS) + list(_RELAY_CONFIGS)
                  + list(_SERVE_CONFIGS) + list(_SERVE_CHAOS_CONFIGS)
-                 + list(_FLEET_CONFIGS) + list(_FRONT_DOOR_CONFIGS)):
+                 + list(_FLEET_CONFIGS) + list(_FRONT_DOOR_CONFIGS)
+                 + list(_AUTOSCALE_CONFIGS)):
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--config", name],
             capture_output=True, text=True, cwd=os.path.dirname(
@@ -2926,7 +3214,8 @@ def main() -> None:
                  + list(_LIVE_CONFIGS) + list(_EIGHTP_CONFIGS)
                  + list(_MULTIHOST_CONFIGS) + list(_RELAY_CONFIGS)
                  + list(_SERVE_CONFIGS) + list(_SERVE_CHAOS_CONFIGS)
-                 + list(_FLEET_CONFIGS) + list(_FRONT_DOOR_CONFIGS))
+                 + list(_FLEET_CONFIGS) + list(_FRONT_DOOR_CONFIGS)
+                 + list(_AUTOSCALE_CONFIGS))
         if idx >= len(args) or args[idx] not in valid:
             print(f"bench: --config needs one of: {', '.join(valid)}",
                   file=sys.stderr)

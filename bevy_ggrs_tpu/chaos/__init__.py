@@ -21,8 +21,10 @@ from bevy_ggrs_tpu.chaos.plan import (
     Partition,
     RelayKillRestart,
     Reorder,
+    ServerDrain,
     ServerKillRestart,
     ServerLoss,
+    ServerSpawn,
 )
 from bevy_ggrs_tpu.chaos.socket import ChaosSocket
 
@@ -38,6 +40,8 @@ __all__ = [
     "Partition",
     "RelayKillRestart",
     "Reorder",
+    "ServerDrain",
     "ServerKillRestart",
     "ServerLoss",
+    "ServerSpawn",
 ]

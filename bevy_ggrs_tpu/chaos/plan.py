@@ -163,10 +163,39 @@ class ServerLoss:
     server: object
 
 
+@dataclasses.dataclass(frozen=True)
+class ServerSpawn:
+    """Script an ELASTIC fleet-size increase: at ``at`` the harness
+    spawns a fresh MatchServer with id ``server`` and registers it with
+    the control plane (for subprocess fleets, a real spawned process —
+    see :class:`~bevy_ggrs_tpu.fleet.proc.ProcFleet`). Distinct from the
+    autopilot's own watermark-driven scale-up: this one is *forced* by
+    the plan, so an elastic soak exercises spawn-under-chaos at a seeded,
+    replayable time regardless of where occupancy happens to sit.
+    Harness-level execution, like the kill family."""
+
+    at: float
+    server: object
+
+
+@dataclasses.dataclass(frozen=True)
+class ServerDrain:
+    """Script an ELASTIC fleet-size decrease: at ``at`` the harness marks
+    server ``server`` draining; the autopilot (or the harness) must then
+    drain-pack-retire it — migrate every hosted match off through the
+    live-migration wire and retire the member only once empty. Forced by
+    the plan for the same reason as :class:`ServerSpawn`: the
+    drain-pack-retire sequence replays from the seed even when occupancy
+    alone would never have triggered it."""
+
+    at: float
+    server: object
+
+
 Directive = Union[
     LossBurst, Reorder, Duplicate, Corrupt, Partition, KillRestart,
     RelayKillRestart, ServerKillRestart, BalancerPartition, MigrateMatch,
-    ServerLoss,
+    ServerLoss, ServerSpawn, ServerDrain,
 ]
 
 _KINDS = {
@@ -181,6 +210,8 @@ _KINDS = {
     "balancer_partition": BalancerPartition,
     "migrate_match": MigrateMatch,
     "server_loss": ServerLoss,
+    "server_spawn": ServerSpawn,
+    "server_drain": ServerDrain,
 }
 _NAMES = {cls: name for name, cls in _KINDS.items()}
 
@@ -261,6 +292,18 @@ class ChaosPlan:
             key=lambda d: d.at,
         )
 
+    def server_spawns(self) -> List[ServerSpawn]:
+        return sorted(
+            (d for d in self.directives if isinstance(d, ServerSpawn)),
+            key=lambda d: d.at,
+        )
+
+    def server_drains(self) -> List[ServerDrain]:
+        return sorted(
+            (d for d in self.directives if isinstance(d, ServerDrain)),
+            key=lambda d: d.at,
+        )
+
     def horizon(self) -> float:
         """Time at which the last directive has expired/healed."""
         t = 0.0
@@ -269,7 +312,9 @@ class ChaosPlan:
                 d, (KillRestart, RelayKillRestart, ServerKillRestart)
             ):
                 t = max(t, d.at + d.down_for)
-            elif isinstance(d, (MigrateMatch, ServerLoss)):
+            elif isinstance(
+                d, (MigrateMatch, ServerLoss, ServerSpawn, ServerDrain)
+            ):
                 t = max(t, d.at)
             else:
                 t = max(t, d.end)
@@ -315,6 +360,7 @@ class ChaosPlan:
         match_server: Optional[object] = None,
         fleet: Tuple[object, ...] = (),
         fleet_matches: int = 0,
+        elastic: bool = False,
     ) -> "ChaosPlan":
         """A deterministic mixed-fault schedule over ``duration`` seconds:
         a few loss bursts, one reorder window, one duplication window, one
@@ -329,8 +375,13 @@ class ChaosPlan:
         with ≥2 members one :class:`ServerLoss` late in the run. Fleet
         draws come AFTER every pre-existing draw, so adding them never
         perturbs the loss/reorder/kill schedule an older seed produced.
-        Same ``(seed, duration, peers, relay, match_server, fleet,
-        fleet_matches)`` -> same plan, always."""
+        With ``elastic=True`` (requires ``fleet``) the elastic family is
+        appended LAST of all — one :class:`ServerSpawn` of a fresh id
+        mid-run, one :class:`ServerDrain` of an existing member after it
+        — so every pre-elastic plan a seed ever produced stays
+        byte-identical. Same ``(seed, duration, peers, relay,
+        match_server, fleet, fleet_matches, elastic)`` -> same plan,
+        always."""
         rng = np.random.RandomState(seed & 0x7FFFFFFF)
         span = max(float(duration), 1.0)
         d: List[Directive] = []
@@ -389,4 +440,13 @@ class ChaosPlan:
                 t0 = float(rng.uniform(0.6 * span, 0.8 * span))
                 d.append(ServerLoss(
                     t0, fleet[int(rng.randint(0, len(fleet)))]))
+        if fleet and elastic:
+            # Elastic family — drawn LAST of all (after the fleet family),
+            # preserving byte-identity of every pre-elastic schedule.
+            fresh = max(int(s) for s in fleet) + 1
+            t0 = float(rng.uniform(0.2 * span, 0.4 * span))
+            d.append(ServerSpawn(t0, fresh))
+            t0 = float(rng.uniform(0.45 * span, 0.6 * span))
+            d.append(ServerDrain(
+                t0, fleet[int(rng.randint(0, len(fleet)))]))
         return cls(seed, tuple(d))

@@ -30,14 +30,34 @@ arrivals, spectator subscribes, abandons) and :class:`Matchmaker`
 (routes due arrivals through ``place_match`` with per-arrival
 :class:`~bevy_ggrs_tpu.serve.admission.AdmissionTrace` carried end to
 end). docs/serving.md "Front door" covers the model.
+
+``fleet.autopilot`` closes the control loop (docs/serving.md
+"Autopilot"): :class:`FleetAutopilot` consumes the type-22 heartbeat
+stream + front-door window-SLO levels and initiates burn preemption,
+anti-affinity-aware placement, and watermark autoscaling
+(spawn / drain-pack-retire) as typed, reasoned, offline-replayable
+:class:`AutopilotAction` decisions. ``fleet.proc`` makes it real:
+supervised subprocess MatchServers over real UDP sockets
+(:class:`ProcFleet` / :class:`ServerProcess`).
 """
 
+from bevy_ggrs_tpu.fleet.autopilot import (
+    AutopilotAction,
+    AutopilotConfig,
+    AutopilotPolicy,
+    BalancerFleet,
+    FleetAutopilot,
+    FleetObservation,
+    ServerSample,
+    heartbeat_score,
+)
 from bevy_ggrs_tpu.fleet.balancer import (
     FleetBalancer,
     FleetMember,
     Migration,
     Placement,
 )
+from bevy_ggrs_tpu.fleet.proc import ProcFleet, ServerProcess
 from bevy_ggrs_tpu.fleet.traffic import (
     MatchAbandon,
     MatchArrival,
@@ -47,13 +67,23 @@ from bevy_ggrs_tpu.fleet.traffic import (
 )
 
 __all__ = [
+    "AutopilotAction",
+    "AutopilotConfig",
+    "AutopilotPolicy",
+    "BalancerFleet",
+    "FleetAutopilot",
     "FleetBalancer",
     "FleetMember",
+    "FleetObservation",
     "MatchAbandon",
     "MatchArrival",
     "Matchmaker",
     "Migration",
     "Placement",
+    "ProcFleet",
+    "ServerProcess",
+    "ServerSample",
     "SpectatorSubscribe",
     "TrafficPlan",
+    "heartbeat_score",
 ]

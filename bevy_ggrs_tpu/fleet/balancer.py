@@ -118,6 +118,7 @@ class FleetMember:
     sock: object = None
     checkpoint_dir: Optional[str] = None
     alive: bool = True
+    draining: bool = False
     last_beat: Optional[float] = None
     info: Optional[proto.FleetHeartbeat] = None
 
@@ -174,6 +175,8 @@ class FleetBalancer:
         metrics=None,
         tracer=None,
         page_refusal_threshold: int = 1,
+        spec_hit_weight: float = 0.25,
+        spec_waste_weight: float = 0.5,
     ):
         import time as _time
 
@@ -194,6 +197,11 @@ class FleetBalancer:
         # >= this many SLO pages is REFUSED as a placement target while
         # any calmer candidate exists (<=0 disables the refusal).
         self.page_refusal_threshold = int(page_refusal_threshold)
+        # Speculation economics in the placement score (heartbeat
+        # spec_hit/spec_waste permille): sub-occupancy weights, so they
+        # only break ties between equally-loaded calm servers.
+        self.spec_hit_weight = float(spec_hit_weight)
+        self.spec_waste_weight = float(spec_waste_weight)
         self.placements_refused_paging = 0
         self.placements_on_paging = 0
         self.members: Dict[int, FleetMember] = {}
@@ -233,6 +241,71 @@ class FleetBalancer:
             for m in self.members.values()
             if m.alive and m.server is not None
         ]
+
+    def set_draining(self, server_id: int, draining: bool = True) -> None:
+        """A draining member stops being a placement/migration target (its
+        hosted matches keep serving) — the first act of the autopilot's
+        drain-pack-retire sequence."""
+        self.members[int(server_id)].draining = bool(draining)
+        if draining:
+            self.metrics.count("fleet_servers_draining")
+        self.tracer.instant(
+            "fleet_drain", server=int(server_id), draining=bool(draining)
+        )
+
+    def retire_member(self, server_id: int) -> FleetMember:
+        """Remove a drained member from the fleet. Refuses (ValueError)
+        while any placement still points at it — retire is the LAST act
+        of drain-pack-retire, never a way to lose matches. The caller
+        owns the returned member's server/socket teardown."""
+        sid = int(server_id)
+        hosted = [
+            pl.match_id
+            for pl in self.placements.values()
+            if pl.server_id == sid
+        ]
+        if hosted:
+            raise ValueError(
+                f"server {sid} still hosts matches {hosted}; pack them off "
+                "before retiring"
+            )
+        member = self.members.pop(sid)
+        self.metrics.count("fleet_servers_retired")
+        self.tracer.instant("fleet_retire", server=sid)
+        return member
+
+    def fleet_rows(self) -> List[Dict]:
+        """Per-server fleet table rows (occupancy, burn, spec quality)
+        for the HTML ops report (:func:`~bevy_ggrs_tpu.obs.report.
+        build_report` ``fleet=``)."""
+        rows = []
+        for sid, m in sorted(self.members.items()):
+            hb = m.info
+            if hb is None and m.alive and m.server is not None:
+                hb = m.server.heartbeat()
+            row = {
+                "server_id": sid,
+                "alive": m.alive,
+                "draining": m.draining,
+                "matches": sum(
+                    1 for pl in self.placements.values()
+                    if pl.server_id == sid
+                ),
+            }
+            if hb is not None:
+                total = max(1, hb.slots_active + hb.slots_free)
+                row.update(
+                    slots_active=hb.slots_active,
+                    slots_free=hb.slots_free,
+                    occupancy=hb.slots_active / total,
+                    pages=hb.pages,
+                    quarantined=hb.quarantined,
+                    spec_hit_permille=hb.spec_hit_permille,
+                    spec_waste_permille=hb.spec_waste_permille,
+                    score=self._score(m),
+                )
+            rows.append(row)
+        return rows
 
     # -- heartbeats + death detection ------------------------------------
 
@@ -293,13 +366,16 @@ class FleetBalancer:
         """Lower is better. Heartbeat-derived burn: SLO pages dominate,
         quarantined/recovering slots next, occupancy breaks ties —
         so a healthy-but-full server loses to a healthy-and-empty one
-        and any paging server loses to both."""
+        and any paging server loses to both. The heartbeat's speculation
+        economics (hit/waste permille) ride below occupancy's unit
+        scale: between equally-loaded calm servers, the one burning more
+        device time on wasted branches loses (see
+        :func:`~bevy_ggrs_tpu.fleet.autopilot.heartbeat_score`)."""
+        from bevy_ggrs_tpu.fleet.autopilot import heartbeat_score
+
         hb = m.info if m.info is not None else m.server.heartbeat()
-        total = max(1, hb.slots_active + hb.slots_free)
-        return (
-            100.0 * hb.pages
-            + 25.0 * hb.quarantined
-            + hb.slots_active / total
+        return heartbeat_score(
+            hb, self.spec_hit_weight, self.spec_waste_weight
         )
 
     def _pages(self, m: FleetMember) -> int:
@@ -318,6 +394,7 @@ class FleetBalancer:
             m
             for m in self._alive()
             if m.server_id not in exclude
+            and not m.draining
             and m.server.free_slot_handles()
         ]
         if not candidates:

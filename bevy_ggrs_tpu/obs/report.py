@@ -90,6 +90,43 @@ def _slo_section(slo_snapshot: dict) -> str:
     )
 
 
+def _fleet_section(rows) -> str:
+    """Per-server fleet table: occupancy, burn, speculation quality —
+    the rows :meth:`~bevy_ggrs_tpu.fleet.balancer.FleetBalancer.
+    fleet_rows` (or a ProcFleet) produces."""
+    rows = list(rows)
+    if not rows:
+        return "<p class='small'>no fleet members</p>"
+    out = []
+    for r in sorted(rows, key=lambda r: r.get("server_id", 0)):
+        state = (
+            "dead" if not r.get("alive", True)
+            else ("draining" if r.get("draining") else "up")
+        )
+        state_cls = {"dead": "page", "draining": "warn", "up": "ok"}[state]
+        pages = r.get("pages", 0)
+        quar = r.get("quarantined", 0)
+        occ = r.get("occupancy")
+        out.append([
+            f"server {r.get('server_id')}",
+            (state, state_cls),
+            r.get("matches", ""),
+            r.get("slots_active", ""),
+            r.get("slots_free", ""),
+            "" if occ is None else f"{100.0 * occ:.0f}%",
+            (pages, "page" if pages else "ok"),
+            (quar, "warn" if quar else "ok"),
+            r.get("spec_hit_permille", ""),
+            r.get("spec_waste_permille", ""),
+            "" if r.get("score") is None else f"{r['score']:.3f}",
+        ])
+    return _table(
+        ["server", "state", "matches", "active", "free", "occupancy",
+         "pages", "quarantined", "spec hit ‰", "spec waste ‰", "score"],
+        out,
+    )
+
+
 def _spans_section(tracers: Dict[str, object]) -> str:
     parts = []
     for comp, tracer in sorted(tracers.items()):
@@ -246,6 +283,7 @@ def build_report(
     metrics=None,
     timeseries=None,
     ledger=None,
+    fleet=None,
     notes: Optional[str] = None,
 ) -> str:
     """Render the report; write it to ``path`` when given. ``slo`` is a
@@ -255,10 +293,13 @@ def build_report(
     ``timeseries`` is a :class:`~bevy_ggrs_tpu.obs.timeseries.TimeSeries`
     or its ``snapshot()`` dict; ``ledger`` is a
     :class:`~bevy_ggrs_tpu.obs.ledger.SpeculationLedger` or its
-    ``summary()`` dict."""
+    ``summary()`` dict; ``fleet`` is a list of per-server row dicts
+    (:meth:`~bevy_ggrs_tpu.fleet.balancer.FleetBalancer.fleet_rows`)."""
     sections = []
     if notes:
         sections.append(f"<p>{_esc(notes)}</p>")
+    if fleet is not None:
+        sections.append("<h2>Fleet</h2>" + _fleet_section(fleet))
     if slo is not None:
         snap = slo.snapshot() if hasattr(slo, "snapshot") else dict(slo)
         sections.append("<h2>Slot SLO state</h2>" + _slo_section(snap))

@@ -115,6 +115,15 @@ class SlotSLO:
         w.bad["recovery"].append(recovery_debt > cfg.recovery_debt_limit)
         w.bad["quarantine"].append(bool(quarantined))
 
+    def forget(self, slot: int) -> None:
+        """Drop one slot's windows. A slot's SLO history is per-tenancy:
+        when its match leaves (retire, suspend/migrate, evict), keeping
+        the frozen window would hold the slot at its last level forever —
+        an evacuated-then-idle server would page indefinitely — and the
+        NEXT tenant would inherit the previous tenant's burn."""
+        self._slots.pop(slot, None)
+        self._levels.pop(slot, None)
+
     # -- reduction -------------------------------------------------------
 
     def _objective(self, name: str) -> float:

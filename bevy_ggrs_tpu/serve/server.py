@@ -374,9 +374,35 @@ class MatchServer:
         """Compile the shared batched tick + admit programs (one dispatch
         through group 0 covers every group — they share the executor) AND
         the shared recovery-lane rollout executable, so the drain ->
-        recover -> readmit cycle is recompile-free from here on."""
+        recover -> readmit cycle is recompile-free from here on.
+
+        Also round-trips one template ticket through the checkpoint/
+        migration blob codec: landing a migrated-in match is steady state
+        for a fleet destination, and the decode-side device re-upload
+        programs are shape-specialized and process-local, so without this
+        the FIRST landing would retrace (a churn_recompiles violation)."""
         self.groups[0].warmup()
-        self._make_lane_runner().warmup()
+        lane = self._make_lane_runner()
+        lane.warmup()
+        from .faults import pack_match_record, unpack_match_record
+
+        codec = self.state_codec()
+        unpack_match_record(
+            codec,
+            pack_match_record(
+                codec,
+                {
+                    "handle": None,
+                    "kind": "synctest",
+                    "frame": 0,
+                    "state": lane.state,
+                    "ring": lane.ring,
+                    "input_log": {},
+                    "spec_on": True,
+                    "session_state": None,
+                },
+            ),
+        )
 
     def _make_lane_runner(self):
         from bevy_ggrs_tpu.runner import RollbackRunner
@@ -535,6 +561,7 @@ class MatchServer:
             self.groups[handle.group].retire(handle.slot)
         self._matches.pop(handle, None)
         self._pending_first.pop(handle, None)
+        self._vacate_slo(handle)
 
     def suspend_match(self, handle: MatchHandle) -> SlotTicket:
         """Voluntary drain: extract the match's full trajectory state as a
@@ -550,7 +577,16 @@ class MatchServer:
             )
         ticket = self.groups[handle.group].extract(handle.slot)
         self._matches.pop(handle, None)
+        self._vacate_slo(handle)
         return ticket
+
+    def _vacate_slo(self, handle: MatchHandle) -> None:
+        """Slot SLO history is per-tenancy: a vacated slot's frozen
+        window must not keep the server paging (or damn its next
+        tenant), so drop it with the match."""
+        flat = self._flat_slot(handle)
+        self.slo.forget(flat)
+        self.slo_levels.pop(flat, None)
 
     def resume_match(
         self,
@@ -717,6 +753,7 @@ class MatchServer:
         del self._lanes[handle]
         self._reserved[handle.group].discard(handle.slot)
         self._matches.pop(handle, None)
+        self._vacate_slo(handle)
         self.evictions_total += 1
         self.metrics.count("slot_evictions")
         self.metrics.count(

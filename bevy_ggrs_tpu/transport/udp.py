@@ -56,6 +56,20 @@ class UdpSocket:
             out.append((addr, msg))
         return out
 
+    def local_port(self) -> int:
+        """The bound local port — the way a socket constructed with port 0
+        (kernel-assigned ephemeral, the fleet subprocess runtime's default)
+        learns its own address to advertise."""
+        if self._native is not None:
+            dup = socket.fromfd(
+                self._native._fd, socket.AF_INET, socket.SOCK_DGRAM
+            )
+            try:
+                return dup.getsockname()[1]
+            finally:
+                dup.close()
+        return self._sock.getsockname()[1]
+
     def close(self) -> None:
         if self._native is not None:
             self._native.close()

@@ -1,0 +1,525 @@
+"""Autopilot policy contracts, unit-driven on synthetic heartbeat traces
+— no live servers anywhere in this file.
+
+The acceptance checklist for ``fleet/autopilot.py``:
+
+- Watermark crossings scale only after the confirm streak (a paging
+  front door collapses the scale-up confirm to one beat).
+- A burning server's heartbeat pages trigger preemptive migration after
+  ``preempt_confirm`` beats, to the calmest admittable destination.
+- Anti-affinity: a move whose only destination is the match's backup
+  server is REFUSED with a typed reason, once per blocking episode.
+- Cooldowns suppress repeat scale/preempt decisions, as typed refusals.
+- Drain-pack-retire ordering: pack strictly before retire, retire only
+  when the draining server is empty, no second drain while one is open.
+- The policy is a pure function of the observation trace: the recorded
+  ledger replays IDENTICAL through a fresh policy, offline.
+
+Plus the satellites that ride along: elastic ChaosPlan directives
+(drawn LAST, byte-stable, replayable), the balancer's speculation-
+economics placement fold, and the ops report's fleet table.
+"""
+
+import json
+
+import pytest
+
+from bevy_ggrs_tpu.chaos import ChaosPlan, ServerDrain, ServerSpawn
+from bevy_ggrs_tpu.fleet.autopilot import (
+    AutopilotAction,
+    AutopilotConfig,
+    AutopilotPolicy,
+    FleetAutopilot,
+    FleetObservation,
+    ServerSample,
+    _main,
+    heartbeat_score,
+    observation_from_json,
+    observation_to_json,
+    replay_ledger,
+    verify_ledger,
+)
+from bevy_ggrs_tpu.fleet.balancer import FleetBalancer
+from bevy_ggrs_tpu.session import protocol as proto
+
+
+def srv(sid, active, free, pages=0, quarantined=0, hit=0, waste=0,
+        draining=False):
+    return ServerSample(
+        server_id=sid, slots_active=active, slots_free=free, pages=pages,
+        quarantined=quarantined, spec_hit_permille=hit,
+        spec_waste_permille=waste, draining=draining,
+    )
+
+
+def obs(tick, servers, placements=None, backups=None, front_door="ok"):
+    return FleetObservation(
+        tick=tick,
+        servers={s.server_id: s for s in servers},
+        placements=dict(placements or {}),
+        backups=dict(backups or {}),
+        front_door=front_door,
+    )
+
+
+def kinds(actions):
+    return [a.kind for a in actions]
+
+
+CFG = AutopilotConfig(
+    confirm_beats=3, preempt_confirm=2, cooldown_scale_ticks=20,
+    cooldown_preempt_ticks=10, min_servers=2, max_servers=4,
+)
+
+
+# ---------------------------------------------------------------------------
+# Scoring
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_score_spec_economics_below_pages():
+    calm = srv(0, 2, 2)
+    wasteful = srv(1, 2, 2, waste=800)
+    hitting = srv(2, 2, 2, hit=900)
+    paging = srv(3, 0, 4, pages=1)
+    assert heartbeat_score(wasteful) > heartbeat_score(calm)
+    assert heartbeat_score(hitting) < heartbeat_score(calm)
+    # A page outweighs any speculation economics.
+    assert heartbeat_score(paging) > heartbeat_score(wasteful)
+
+
+# ---------------------------------------------------------------------------
+# Watermark crossings + hysteresis
+# ---------------------------------------------------------------------------
+
+
+def test_scale_up_waits_for_confirm_streak():
+    p = AutopilotPolicy(CFG)
+    hot = [srv(0, 4, 0), srv(1, 3, 1)]  # occupancy 7/8 = 0.875
+    assert p.decide(obs(0, hot)) == []
+    assert p.decide(obs(1, hot)) == []
+    acts = p.decide(obs(2, hot))
+    assert kinds(acts) == ["scale_up"]
+    assert "high watermark" in acts[0].reason
+
+
+def test_high_streak_resets_below_watermark():
+    p = AutopilotPolicy(CFG)
+    hot = [srv(0, 4, 0), srv(1, 3, 1)]
+    cool = [srv(0, 2, 2), srv(1, 2, 2)]
+    p.decide(obs(0, hot))
+    p.decide(obs(1, hot))
+    p.decide(obs(2, cool))  # streak resets
+    assert p.decide(obs(3, hot)) == []
+    assert p.decide(obs(4, hot)) == []
+    assert kinds(p.decide(obs(5, hot))) == ["scale_up"]
+
+
+def test_paging_front_door_collapses_confirm_to_one_beat():
+    p = AutopilotPolicy(CFG)
+    hot = [srv(0, 4, 0), srv(1, 3, 1)]
+    acts = p.decide(obs(0, hot, front_door="page"))
+    assert kinds(acts) == ["scale_up"]
+    assert "front door paging" in acts[0].reason
+
+
+def test_scale_up_respects_max_servers():
+    p = AutopilotPolicy(dataclasses_replace(CFG, max_servers=2))
+    hot = [srv(0, 4, 0), srv(1, 4, 0)]
+    for t in range(6):
+        assert p.decide(obs(t, hot)) == []
+
+
+def dataclasses_replace(cfg, **kw):
+    import dataclasses
+
+    return dataclasses.replace(cfg, **kw)
+
+
+def test_scale_cooldown_refuses_once_then_allows():
+    p = AutopilotPolicy(CFG)
+    hot = [srv(0, 4, 0), srv(1, 3, 1)]
+    for t in range(3):
+        acts = p.decide(obs(t, hot))
+    assert kinds(acts) == ["scale_up"]  # fired at tick 2
+    # Still hot: next confirm crossing lands inside the cooldown window
+    # and is refused EXACTLY once for the whole blocked episode.
+    refusals = []
+    for t in range(3, 3 + CFG.cooldown_scale_ticks - 3):
+        refusals += [
+            a for a in p.decide(obs(t, hot)) if a.kind == "refuse"
+        ]
+    assert len(refusals) == 1
+    assert "cooldown" in refusals[0].reason
+    # Past the cooldown the confirm streak is long satisfied: scale-up.
+    acts = p.decide(obs(2 + CFG.cooldown_scale_ticks, hot))
+    assert kinds(acts) == ["scale_up"]
+
+
+# ---------------------------------------------------------------------------
+# Burn preemption
+# ---------------------------------------------------------------------------
+
+
+def test_burn_page_preemption_after_confirm_to_calmest_dst():
+    p = AutopilotPolicy(CFG)
+    placements = {7: 0, 8: 1}
+    backups = {7: 1, 8: 0}
+    burning = [srv(0, 1, 3, pages=1), srv(1, 1, 3), srv(2, 0, 4)]
+    assert p.decide(obs(0, burning, placements, backups)) == []  # beat 1
+    acts = p.decide(obs(1, burning, placements, backups))
+    assert kinds(acts) == ["preempt_migrate"]
+    a = acts[0]
+    # Backup server 1 is excluded; calmest remaining candidate is 2.
+    assert (a.server_id, a.match_id, a.dst_id) == (0, 7, 2)
+    assert "before the watchdog" in a.reason
+
+
+def test_preemption_streak_resets_when_pages_clear():
+    p = AutopilotPolicy(CFG)
+    placements = {7: 0}
+    hot = [srv(0, 1, 3, pages=1), srv(1, 0, 4)]
+    calm = [srv(0, 1, 3), srv(1, 0, 4)]
+    p.decide(obs(0, hot, placements))
+    p.decide(obs(1, calm, placements))  # streak resets
+    assert p.decide(obs(2, hot, placements)) == []
+    assert kinds(p.decide(obs(3, hot, placements))) == ["preempt_migrate"]
+
+
+def test_preempt_cooldown_refuses_once():
+    p = AutopilotPolicy(CFG)
+    placements = {7: 0, 8: 0}
+    hot = [srv(0, 2, 2, pages=1), srv(1, 0, 4)]
+    p.decide(obs(0, hot, placements))
+    acts = p.decide(obs(1, hot, placements))
+    assert kinds(acts) == ["preempt_migrate"]
+    refusals = []
+    for t in range(2, CFG.cooldown_preempt_ticks):
+        refusals += [
+            a for a in p.decide(obs(t, hot, placements))
+            if a.kind == "refuse"
+        ]
+    assert len(refusals) == 1
+    assert "cooldown" in refusals[0].reason and refusals[0].server_id == 0
+    acts = p.decide(obs(1 + CFG.cooldown_preempt_ticks, hot, placements))
+    assert kinds(acts) == ["preempt_migrate"]
+
+
+def test_anti_affinity_refusal_typed_and_deduped():
+    p = AutopilotPolicy(CFG)
+    placements = {7: 0}
+    backups = {7: 1}  # the ONLY other server is the backup
+    hot = [srv(0, 1, 3, pages=1), srv(1, 0, 4)]
+    p.decide(obs(0, hot, placements, backups))
+    acts = p.decide(obs(1, hot, placements, backups))
+    assert kinds(acts) == ["refuse"]
+    assert "anti_affinity" in acts[0].reason
+    assert acts[0].match_id == 7
+    # Same blocking episode: no duplicate refusal spam.
+    assert p.decide(obs(2, hot, placements, backups)) == []
+    # A third server appears: the move proceeds, avoiding the backup.
+    wide = hot + [srv(2, 0, 4)]
+    acts = p.decide(obs(3, wide, placements, backups))
+    assert kinds(acts) == ["preempt_migrate"]
+    assert acts[0].dst_id == 2
+
+
+# ---------------------------------------------------------------------------
+# Drain-pack-retire
+# ---------------------------------------------------------------------------
+
+
+def test_scale_down_drain_pack_retire_ordering():
+    p = AutopilotPolicy(CFG)
+    placements = {1: 0, 2: 1, 3: 2}
+    idle = [srv(0, 1, 3), srv(1, 1, 3), srv(2, 1, 3)]  # occupancy 0.25
+    assert p.decide(obs(0, idle, placements)) == []
+    assert p.decide(obs(1, idle, placements)) == []
+    acts = p.decide(obs(2, idle, placements))
+    assert kinds(acts) == ["scale_down"]
+    # Emptiest-tie retires the newest id.
+    victim = acts[0].server_id
+    assert victim == 2
+    # The actuator marks it draining; next tick packs its matches.
+    draining = [srv(0, 1, 3), srv(1, 1, 3),
+                srv(2, 1, 3, draining=True)]
+    acts = p.decide(obs(3, draining, placements, backups={3: 0}))
+    assert kinds(acts) == ["pack_migrate"]
+    assert (acts[0].match_id, acts[0].server_id) == (3, 2)
+    assert acts[0].dst_id == 1  # backup 0 excluded by anti-affinity
+    # While the drain is open, NO second scale-down can start.
+    low2 = [srv(0, 1, 3), srv(1, 1, 3), srv(2, 0, 4, draining=True)]
+    moved = {1: 0, 2: 1, 3: 1}
+    for t in range(4, 10):
+        acts = p.decide(obs(t, low2, moved))
+        assert kinds(acts) == ["retire"]  # empty drain -> retire, only
+        assert acts[0].server_id == 2
+
+
+def test_pack_batch_bounds_per_tick_moves():
+    p = AutopilotPolicy(CFG)
+    placements = {m: 0 for m in range(4)}
+    servers = [srv(0, 4, 0, draining=True), srv(1, 0, 4), srv(2, 0, 4)]
+    acts = p.decide(obs(0, servers, placements))
+    packs = [a for a in acts if a.kind == "pack_migrate"]
+    assert len(packs) == CFG.pack_batch
+    assert [a.match_id for a in packs] == [0, 1]
+
+
+def test_scale_down_never_below_min_servers():
+    p = AutopilotPolicy(CFG)
+    idle = [srv(0, 0, 4), srv(1, 1, 3)]
+    for t in range(8):
+        assert p.decide(obs(t, idle, {9: 1})) == []
+
+
+# ---------------------------------------------------------------------------
+# Determinism: ledger roundtrip + offline replay harness
+# ---------------------------------------------------------------------------
+
+
+class ScriptedFleet:
+    """A fleet adapter that replays a scripted sample sequence; every
+    actuation succeeds without side effects (the policy's view of the
+    world is entirely the script)."""
+
+    def __init__(self, script):
+        self.script = script  # list of (samples, placements)
+        self.t = 0
+        self.calls = []
+
+    def samples(self):
+        return dict(self.script[min(self.t, len(self.script) - 1)][0])
+
+    def placements(self):
+        return dict(self.script[min(self.t, len(self.script) - 1)][1])
+
+    def pump_migrations(self):
+        self.t += 1
+
+    def migrate(self, m, d):
+        self.calls.append(("migrate", m, d))
+        return True
+
+    def spawn(self):
+        self.calls.append(("spawn",))
+        return True
+
+    def set_draining(self, s):
+        self.calls.append(("drain", s))
+        return True
+
+    def retire(self, s):
+        self.calls.append(("retire", s))
+        return True
+
+
+def scripted_run():
+    hot = {0: srv(0, 4, 0), 1: srv(1, 3, 1)}
+    burn = {0: srv(0, 4, 0, pages=1), 1: srv(1, 3, 1), 2: srv(2, 0, 4)}
+    idle = {0: srv(0, 1, 3), 1: srv(1, 0, 4), 2: srv(2, 0, 4)}
+    pl = {5: 0, 6: 1}
+    script = (
+        [(hot, pl)] * 4 + [(burn, pl)] * 4 + [(idle, {5: 0})] * 6
+    )
+    fleet = ScriptedFleet([(dict(s), dict(p)) for s, p in script])
+    ap = FleetAutopilot(fleet, config=CFG)
+    for t in range(len(script)):
+        ap.step(t)
+    return ap
+
+
+def test_observation_json_roundtrip():
+    o = obs(3, [srv(0, 2, 2, pages=1, waste=100)], {9: 0}, {9: 1},
+            front_door="warn")
+    back = observation_from_json(
+        json.loads(json.dumps(observation_to_json(o)))
+    )
+    assert back == o
+
+
+def test_ledger_replays_identical(tmp_path):
+    ap = scripted_run()
+    assert ap.counts.get("scale_up", 0) >= 1
+    assert ap.counts.get("preempt_migrate", 0) >= 1
+    path = str(tmp_path / "autopilot_ledger.jsonl")
+    n = ap.export_jsonl(path)
+    assert n == len(ap.ledger)
+    ok, ticks = verify_ledger(path, config=CFG)
+    assert (ok, ticks) == (True, n)
+    # The CLI harness agrees.
+    assert _main([path]) == 0
+
+
+def test_ledger_divergence_detected(tmp_path):
+    ap = scripted_run()
+    recs = [json.loads(json.dumps(r)) for r in ap.ledger]
+    # Tamper with one recorded decision: replay must flag it.
+    for r in recs:
+        if r["actions"]:
+            r["actions"][0]["kind"] = "scale_down"
+            break
+    assert verify_ledger(recs, config=CFG)[0] is False
+    path = str(tmp_path / "tampered.jsonl")
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    assert _main([path]) == 1
+
+
+def test_replay_is_pure_of_executor_state():
+    """replay_ledger rebuilds decisions from observations alone — the
+    same trace through two fresh policies is bitwise the same actions."""
+    ap = scripted_run()
+    a = replay_ledger(ap.ledger, config=CFG)
+    b = replay_ledger(ap.ledger, config=CFG)
+    assert a == b
+    flat = [x for tick in a for x in tick]
+    assert any(x.kind == "preempt_migrate" for x in flat)
+
+
+def test_autopilot_books_anti_affinity_backups():
+    samples = {0: srv(0, 1, 3), 1: srv(1, 0, 4), 2: srv(2, 0, 4)}
+    fleet = ScriptedFleet([(samples, {7: 0, 8: 1})] * 3)
+    ap = FleetAutopilot(fleet, config=CFG)
+    ap.step(0)
+    # Lowest-id live non-host server is the backup.
+    assert ap.backups == {7: 1, 8: 0}
+    # Host change (migration) keeps a still-valid backup stable.
+    fleet.script = [(samples, {7: 2, 8: 1})] * 3
+    fleet.t = 0
+    ap.step(1)
+    assert ap.backups[7] == 1
+
+
+# ---------------------------------------------------------------------------
+# Satellite: elastic chaos directives
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_directives_drawn_last_and_byte_stable():
+    kw = dict(
+        duration=30.0, peers=[("p", 0), ("p", 1)],
+        fleet=(0, 1, 2), fleet_matches=3,
+    )
+    plan = ChaosPlan.generate(11, elastic=True, **kw)
+    spawns, drains = plan.server_spawns(), plan.server_drains()
+    assert len(spawns) == 1 and len(drains) == 1
+    # The spawned id is fresh; the drained id is an existing member.
+    assert spawns[0].server not in (0, 1, 2)
+    assert drains[0].server in (0, 1, 2)
+    assert drains[0].at > spawns[0].at
+    # Drawn LAST: the pre-elastic plan from the same seed is untouched.
+    base = ChaosPlan.generate(11, **kw)
+    assert base.directives == plan.directives[: -2]
+    # Byte-stable JSON roundtrip + seeded replayability.
+    again = ChaosPlan.from_json(plan.to_json())
+    assert again.directives == plan.directives
+    assert again.to_json() == plan.to_json()
+    assert ChaosPlan.generate(11, elastic=True, **kw).to_json() \
+        == plan.to_json()
+
+
+def test_elastic_wire_types_in_registry():
+    plan = ChaosPlan(
+        seed=1,
+        directives=(ServerSpawn(2.0, 3), ServerDrain(5.0, 1)),
+    )
+    back = ChaosPlan.from_json(plan.to_json())
+    assert back.directives == plan.directives
+    assert back.horizon() >= 5.0
+
+
+# ---------------------------------------------------------------------------
+# Satellite: balancer spec fold + fleet table rows
+# ---------------------------------------------------------------------------
+
+
+class StubServer:
+    """The minimal server surface the balancer touches when every member
+    has fresh heartbeat info: capacity probing and a fallback beacon."""
+
+    def __init__(self, sid=0, free=4):
+        self.sid, self.free = sid, free
+
+    def free_slot_handles(self):
+        return list(range(self.free))
+
+    def heartbeat(self):
+        return proto.FleetHeartbeat(self.sid, 0, 0, self.free, 0, 0)
+
+
+def test_placement_folds_spec_economics():
+    bal = FleetBalancer()
+    a = bal.register(0, StubServer(0))
+    b = bal.register(1, StubServer(1))
+    # Identical load/burn; server 0 wastes speculative device time.
+    a.info = proto.FleetHeartbeat(0, 0, 2, 2, 0, 0, 100, 700)
+    b.info = proto.FleetHeartbeat(1, 0, 2, 2, 0, 0, 100, 100)
+    assert bal.place().server_id == 1
+    # Now server 1 also hits far less -> its discount shrinks.
+    a.info = proto.FleetHeartbeat(0, 0, 2, 2, 0, 0, 900, 200)
+    b.info = proto.FleetHeartbeat(1, 0, 2, 2, 0, 0, 0, 200)
+    assert bal.place().server_id == 0
+    # Pages still dominate any speculation advantage.
+    a.info = proto.FleetHeartbeat(0, 0, 2, 2, 0, 1, 1000, 0)
+    assert bal.place().server_id == 1
+
+
+def test_draining_member_excluded_from_placement():
+    bal = FleetBalancer()
+    a = bal.register(0, StubServer(0))
+    b = bal.register(1, StubServer(1))
+    a.info = proto.FleetHeartbeat(0, 0, 0, 4, 0, 0)
+    b.info = proto.FleetHeartbeat(1, 0, 3, 1, 0, 0)
+    assert bal.place().server_id == 0
+    bal.set_draining(0)
+    assert bal.place().server_id == 1
+    bal.set_draining(0, draining=False)
+    assert bal.place().server_id == 0
+
+
+def test_retire_member_refuses_until_empty():
+    bal = FleetBalancer()
+    bal.register(0, StubServer(0))
+    bal.register(1, StubServer(1))
+    from bevy_ggrs_tpu.fleet.balancer import Placement
+
+    bal.placements[5] = Placement(
+        match_id=5, server_id=0, handle=None, session=None,
+        local_inputs=None,
+    )
+    with pytest.raises(ValueError, match="still hosts"):
+        bal.retire_member(0)
+    del bal.placements[5]
+    member = bal.retire_member(0)
+    assert member.server_id == 0 and 0 not in bal.members
+
+
+def test_fleet_rows_expose_spec_and_state():
+    bal = FleetBalancer()
+    a = bal.register(0, StubServer(0))
+    bal.register(1, StubServer(1))
+    a.info = proto.FleetHeartbeat(0, 0, 3, 1, 1, 2, 640, 210)
+    bal.set_draining(1)
+    rows = {r["server_id"]: r for r in bal.fleet_rows()}
+    assert rows[0]["spec_hit_permille"] == 640
+    assert rows[0]["spec_waste_permille"] == 210
+    assert rows[0]["occupancy"] == 0.75
+    assert rows[0]["pages"] == 2 and rows[0]["quarantined"] == 1
+    assert rows[1]["draining"] is True
+    assert "score" in rows[0]
+
+
+def test_report_renders_fleet_table():
+    from bevy_ggrs_tpu.obs.report import build_report
+
+    bal = FleetBalancer()
+    a = bal.register(0, StubServer(0))
+    a.info = proto.FleetHeartbeat(0, 0, 3, 1, 0, 1, 500, 100)
+    bal.register(1, StubServer(1))
+    html = build_report(fleet=bal.fleet_rows(), title="fleet test")
+    assert "Fleet" in html
+    assert "spec hit" in html and "spec waste" in html
+    # Server 0 pages -> its state cell carries the page css class.
+    assert "srv0" in html or ">0<" in html

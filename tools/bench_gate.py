@@ -164,6 +164,38 @@ def check_row(row: dict, base: Optional[dict],
                 out.update(status="FAIL",
                            detail=f"fleet row lost its {col} column")
                 return out
+    if metric.startswith("fleet_autoscale_"):
+        # The elasticity row IS its robustness gates: an arc that lost a
+        # match, compiled during churn, failed to replay its decision
+        # ledger, or landed its preemption on an already-fenced donor is
+        # a regression regardless of the scale-up latency.
+        if row.get("matches_lost") != 0:
+            out.update(status="FAIL",
+                       detail=f"autoscale row lost {row.get('matches_lost')!r} "
+                              "matches (gate: 0)")
+            return out
+        if row.get("churn_recompiles") != 0:
+            out.update(status="FAIL",
+                       detail="autoscale churn compiled "
+                              f"{row.get('churn_recompiles')!r}x (gate: 0)")
+            return out
+        if row.get("preempt_landed_clean") is not True:
+            out.update(status="FAIL",
+                       detail="preemptive migration landed on a donor with "
+                              "fences/faults (preempt_landed_clean != True)")
+            return out
+        if row.get("ledger_replay_identical") is not True:
+            out.update(status="FAIL",
+                       detail="autopilot decision ledger did not replay "
+                              "identical offline")
+            return out
+        for col in ("scale_up_latency_p50_ms", "preempt_latency_s",
+                    "drain_pack_stall_p50_frames",
+                    "drain_pack_stall_p99_frames"):
+            if not isinstance(row.get(col), (int, float)):
+                out.update(status="FAIL",
+                           detail=f"autoscale row lost its {col} column")
+                return out
     if metric.startswith("front_door_"):
         # The saturation-ladder row IS its health gates: a knee measured
         # with slot faults, compiles during admission churn, or a lost
