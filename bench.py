@@ -2768,10 +2768,19 @@ def _front_door_case(S: int) -> dict:
     )
 
 
-_AUTOSCALE_CONFIGS = {"fleet_autoscale_N3": 3}
+_AUTOSCALE_CONFIGS = {
+    "fleet_autoscale_N3": (3, False),
+    # Same arc, but every child UDP socket sits behind a ChaosSocket
+    # running continuous loss/dup/corrupt/reorder plus an asymmetric
+    # partition on server 0's outbound: the reliable control wire
+    # (transport/reliable.py), migration epoch fencing, and the
+    # autopilot's partition-aware degradation have to hold the same
+    # zero-loss / zero-churn / replay-identical bar.
+    "fleet_autoscale_N3_chaos": (3, True),
+}
 
 
-def _fleet_autoscale_case(N: int) -> dict:
+def _fleet_autoscale_case(N: int, chaos: bool = False) -> dict:
     """One full elasticity arc on the SUBPROCESS fleet (fleet/proc.py)
     under the autopilot policy (fleet/autopilot.py): traffic pushes
     occupancy over the high watermark -> policy spawns server N-1 (the
@@ -2803,10 +2812,42 @@ def _fleet_autoscale_case(N: int) -> dict:
         "checkpoint_interval": 40,
     }
     rtt0 = _host_device_rtt_ms()
+    case = f"fleet_autoscale_N{N}" + ("_chaos" if chaos else "")
     root = tempfile.mkdtemp(prefix="ggrs_fleet_autoscale_")
-    td = _bench_trace_dir(f"fleet_autoscale_N{N}")
+    td = _bench_trace_dir(case)
+    chaos_plan = None
+    if chaos:
+        from bevy_ggrs_tpu.chaos.plan import (
+            ChaosPlan,
+            Corrupt,
+            Duplicate,
+            LossBurst,
+            Partition,
+            Reorder,
+        )
+
+        chaos_plan = ChaosPlan(
+            seed=11,
+            directives=(
+                LossBurst(0.0, 1e9, 0.15),
+                Duplicate(0.0, 1e9, 0.10),
+                Corrupt(0.0, 1e9, 0.05),
+                Reorder(0.0, 1e9, 0.10, delay=0.05),
+                # Asymmetric: server 0's sends go dark while it still
+                # hears the world — sized under the death threshold so
+                # the suspect path must hold, not failover.
+                Partition(12.0, 18.0, src=0),
+            ),
+        )
     fleet = ProcFleet(
-        root, base_config=base, heartbeat_timeout=8.0, obs_dir=td
+        root, base_config=base, heartbeat_timeout=8.0, obs_dir=td,
+        chaos_plan=chaos_plan,
+        # Chaos arc: widen the wedged-child backstop. A sibling's cold
+        # JAX boot can starve a 1-core host for >20s, and with the
+        # default 3x factor that crosses the dead threshold — declaring
+        # a live child dead is exactly what the chaos gate forbids. The
+        # suspect path (process probe) still fires at the normal budget.
+        suspect_factor=8 if chaos else 3,
     )
     cfg = AutopilotConfig(
         high_watermark=0.8, low_watermark=0.3, confirm_beats=3,
@@ -2931,16 +2972,24 @@ def _fleet_autoscale_case(N: int) -> dict:
         # Traffic drop: guarantee every member hosts >= 1 match so the
         # drained member must PACK before retiring, then abandon the
         # rest; the policy drain-pack-retires the emptiest member.
+        # Fill-ins race the policy's own drain-pack decisions (a real
+        # hazard under chaos, where the arc runs long enough for the
+        # low watermark to fire early): a draining child refuses admits
+        # with a typed admit_failed that un-books the match, so skip
+        # drainers and let a refusal release the wait.
         keep = {}
         for mid, sid in sorted(fleet.placements().items()):
             keep.setdefault(sid, mid)
-        for sid in sorted(fleet.samples()):
-            if sid not in keep:
+        for sid, sample in sorted(fleet.samples().items()):
+            if sid not in keep and not sample.draining:
                 fleet.admit(200 + sid, sid)
                 keep[sid] = 200 + sid
         pump_until(
-            lambda: all(m in fleet.handles for m in keep.values()), 120,
-            "fill-in admissions serving",
+            lambda: all(
+                m in fleet.handles or m not in fleet.book
+                for m in keep.values()
+            ),
+            120, "fill-in admissions serving",
         )
         for mid in sorted(fleet.placements()):
             if mid not in keep.values():
@@ -2951,26 +3000,36 @@ def _fleet_autoscale_case(N: int) -> dict:
             240, "drain-pack-retire",
         )
         pack_stalls = fleet.stall_frames[stalls_before:]
-        victim = next(
-            e["server"] for e in fleet.events if e["event"] == "retired"
-        )
+        # Packing to min_servers may take several retire cycles (each
+        # gated by the scale cooldown) when chaos-era pages grew the
+        # fleet past N — wait for the whole pack-down, then for every
+        # retired child to actually exit.
         pump_until(
-            lambda: not fleet.members[victim].process.alive(), 60,
-            "retired child exiting",
+            lambda: len(fleet.samples()) == cfg.min_servers, 300,
+            "packing down to min_servers",
         )
+        for victim in sorted(
+            {e["server"] for e in fleet.events if e["event"] == "retired"}
+        ):
+            pump_until(
+                lambda v=victim: not fleet.members[v].process.alive(), 120,
+                f"retired child {victim} exiting",
+            )
 
         # Fleet-wide churn gate: a fresh status from every survivor must
-        # report zero compiles since the steady-state rebase.
+        # report zero compiles since the steady-state rebase. Capture
+        # over the live SERVING set — a just-retired child still has a
+        # pid here but its frame counter will never advance again.
         frames_before = {
-            sid: (m.status or {}).get("frames", 0)
-            for sid, m in fleet.members.items()
-            if m.process.alive()
+            sid: (fleet.members[sid].status or {}).get("frames", 0)
+            for sid in fleet.samples()
         }
         pump_until(
             lambda: all(
                 (fleet.members[sid].status or {}).get("frames", 0)
                 > frames_before[sid]
                 for sid in frames_before
+                if sid in fleet.samples()
             ),
             120, "fresh post-arc status",
         )
@@ -2988,8 +3047,17 @@ def _fleet_autoscale_case(N: int) -> dict:
         ap.export_jsonl(ledger_path)
         replay_ok, ledger_ticks = verify_ledger(ledger_path)
         counts = dict(ap.counts)
+        # Aborts attributable to wire faults or fencing (everything but
+        # the administrative refusals) — the chaos row's blast radius.
+        aborted_chaos = sum(
+            1 for e in fleet.events
+            if e["event"] == "migrate_abort"
+            and e.get("reason") not in (
+                "unknown_match", "duplicate_match", "capacity"
+            )
+        )
         row = _entry(
-            f"fleet_autoscale_N{N}",
+            case,
             float(np.percentile(scale_up_ms, 50)),
             max(frames_total, 1), base.get("num_branches", 8),
             rtt_ms=rtt0,
@@ -3015,9 +3083,14 @@ def _fleet_autoscale_case(N: int) -> dict:
             pack_migrations=len(pack_stalls),
             migrations_completed=int(fleet.migrations_completed),
             migrations_aborted=int(fleet.migrations_aborted),
+            migrations_aborted_chaos=int(aborted_chaos),
             matches_lost=int(fleet.matches_lost),
             failovers=int(fleet.failovers),
             churn_recompiles=int(churn_recompiles),
+            ctrl_retransmits=int(fleet.ctrl_retransmits),
+            epoch_fence_refusals=int(fleet.epoch_fence_refusals),
+            degraded_beats=int(ap.degraded_beats),
+            chaos_faults_injected=int(fleet.chaos_faults),
             ledger_ticks=int(ledger_ticks),
             ledger_replay_identical=bool(replay_ok),
             decisions={k: int(v) for k, v in sorted(counts.items())},
@@ -3034,6 +3107,14 @@ def _fleet_autoscale_case(N: int) -> dict:
                 "landing pre-traced by MatchServer.warmup's blob-codec "
                 "round-trip); the decision ledger replays identical "
                 "offline"
+            ) + (
+                "; CHAOS variant: every child UDP socket behind a "
+                "ChaosSocket (15% loss, 10% dup, 5% corrupt, 10% reorder "
+                "continuous + a 6s asymmetric partition of server 0's "
+                "sends) — the reliable control wire retransmits through "
+                "it, epoch fences refuse stale landings, and the "
+                "partition-aware liveness keeps failovers at 0"
+                if chaos else ""
             ),
         )
     finally:
@@ -3041,7 +3122,7 @@ def _fleet_autoscale_case(N: int) -> dict:
         merged = None
         if td is not None:
             merged = fleet.merge_observability(
-                os.path.join(td, "fleet_autoscale_merged_trace.json")
+                os.path.join(td, f"{case}_merged_trace.json")
             )
         shutil.rmtree(root, ignore_errors=True)
     if merged is not None:
@@ -3099,7 +3180,7 @@ def run_config(name: str) -> dict:
     if name in _FRONT_DOOR_CONFIGS:
         return _front_door_case(_FRONT_DOOR_CONFIGS[name])
     if name in _AUTOSCALE_CONFIGS:
-        return _fleet_autoscale_case(_AUTOSCALE_CONFIGS[name])
+        return _fleet_autoscale_case(*_AUTOSCALE_CONFIGS[name])
     if name in _LIVE_CONFIGS:
         model, speculate, transport = _LIVE_CONFIGS[name]
         rtt0 = _host_device_rtt_ms()

@@ -361,6 +361,7 @@ class ChaosPlan:
         fleet: Tuple[object, ...] = (),
         fleet_matches: int = 0,
         elastic: bool = False,
+        control: bool = False,
     ) -> "ChaosPlan":
         """A deterministic mixed-fault schedule over ``duration`` seconds:
         a few loss bursts, one reorder window, one duplication window, one
@@ -379,9 +380,14 @@ class ChaosPlan:
         appended LAST of all — one :class:`ServerSpawn` of a fresh id
         mid-run, one :class:`ServerDrain` of an existing member after it
         — so every pre-elastic plan a seed ever produced stays
-        byte-identical. Same ``(seed, duration, peers, relay,
-        match_server, fleet, fleet_matches, elastic)`` -> same plan,
-        always."""
+        byte-identical. With ``control=True`` (requires ``fleet``) the
+        control-plane family is appended after the elastic draws — one
+        corruption window, one duplication window, and one asymmetric
+        :class:`Partition` whose ``src`` is a fleet server id (matching
+        the server-id identity fleet ChaosSockets carry) — aimed at the
+        type 18–21 migration wire and the type-22 heartbeat stream. Same
+        ``(seed, duration, peers, relay, match_server, fleet,
+        fleet_matches, elastic, control)`` -> same plan, always."""
         rng = np.random.RandomState(seed & 0x7FFFFFFF)
         span = max(float(duration), 1.0)
         d: List[Directive] = []
@@ -449,4 +455,23 @@ class ChaosPlan:
             t0 = float(rng.uniform(0.45 * span, 0.6 * span))
             d.append(ServerDrain(
                 t0, fleet[int(rng.randint(0, len(fleet)))]))
+        if fleet and control:
+            # Control-plane family — drawn after every other family, so
+            # every pre-control plan a seed ever produced stays
+            # byte-identical. These windows land on the fleet's OWN
+            # sockets (server-id identities): migration frames get
+            # corrupted and duplicated, and one member's outbound — its
+            # heartbeats included — goes dark while it still hears the
+            # world, the asymmetric shape split-brain fencing exists for.
+            t0 = float(rng.uniform(0.15 * span, 0.55 * span))
+            d.append(Corrupt(t0, t0 + 0.1 * span,
+                             float(rng.uniform(0.05, 0.15))))
+            t0 = float(rng.uniform(0.15 * span, 0.55 * span))
+            d.append(Duplicate(t0, t0 + 0.1 * span,
+                               float(rng.uniform(0.1, 0.3))))
+            victim = fleet[int(rng.randint(0, len(fleet)))]
+            t0 = float(rng.uniform(0.25 * span, 0.5 * span))
+            d.append(Partition(
+                t0, t0 + float(rng.uniform(0.03, 0.07) * span),
+                src=victim))
         return cls(seed, tuple(d))

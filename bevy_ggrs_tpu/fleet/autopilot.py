@@ -109,9 +109,22 @@ class ServerSample:
     spec_waste_permille: int = 0
     draining: bool = False
     alive: bool = True
+    # Partition awareness: how many heartbeat periods have elapsed since
+    # this server's last (monotonically newer) beat, and whether the
+    # control plane can still reach it by a non-heartbeat path (process
+    # probe / stdin pipe). ``missed_beats > 0`` with ``reachable=True``
+    # is the network-suspect signature.
+    missed_beats: int = 0
+    reachable: bool = True
 
     @classmethod
-    def from_heartbeat(cls, hb, draining: bool = False) -> "ServerSample":
+    def from_heartbeat(
+        cls,
+        hb,
+        draining: bool = False,
+        missed_beats: int = 0,
+        reachable: bool = True,
+    ) -> "ServerSample":
         return cls(
             server_id=int(hb.server_id),
             slots_active=int(hb.slots_active),
@@ -121,6 +134,8 @@ class ServerSample:
             spec_hit_permille=int(hb.spec_hit_permille),
             spec_waste_permille=int(hb.spec_waste_permille),
             draining=bool(draining),
+            missed_beats=int(missed_beats),
+            reachable=bool(reachable),
         )
 
 
@@ -144,8 +159,9 @@ class FleetObservation:
 class AutopilotAction:
     """One typed, reasoned decision. ``kind`` is one of
     ``scale_up | scale_down | preempt_migrate | pack_migrate | retire |
-    refuse``; ``reason`` is the human-readable justification every
-    decision must carry (the ledger is an audit log, not a counter)."""
+    refuse | partition_suspected | degraded_enter | degraded_exit``;
+    ``reason`` is the human-readable justification every decision must
+    carry (the ledger is an audit log, not a counter)."""
 
     kind: str
     tick: int
@@ -175,6 +191,9 @@ class AutopilotConfig:
     max_servers: int = 8
     spec_hit_weight: float = 0.25
     spec_waste_weight: float = 0.5
+    # Missed beats (while the control-plane probe still answers) that
+    # mark a server network-suspect and put the policy in degraded mode.
+    suspect_beats: int = 2
 
 
 class AutopilotPolicy:
@@ -198,6 +217,11 @@ class AutopilotPolicy:
         # Refusals are emitted once per continuous blocking episode, not
         # once per tick — the ledger stays an audit log, not a firehose.
         self._refused: set = set()
+        # Partition awareness: currently-suspect server ids and whether
+        # the policy is in degraded mode (shrink-side actions frozen).
+        self._suspected: set = set()
+        self._degraded = False
+        self.degraded_beats = 0
 
     # -- helpers ---------------------------------------------------------
 
@@ -205,6 +229,12 @@ class AutopilotPolicy:
         return heartbeat_score(
             s, self.config.spec_hit_weight, self.config.spec_waste_weight
         )
+
+    def _is_suspect(self, s: ServerSample) -> bool:
+        """Network-suspect: beats missing but the control-plane probe
+        still answers. (An unreachable server is *dead* — the fleet's
+        failover reflex, not a policy state.)"""
+        return s.reachable and s.missed_beats >= self.config.suspect_beats
 
     def _refuse_once(
         self, acts: List[AutopilotAction], key, action: AutopilotAction
@@ -224,6 +254,7 @@ class AutopilotPolicy:
             sid
             for sid, s in sorted(servers.items())
             if sid != src_id and not s.draining and s.slots_free > 0
+            and not self._is_suspect(s)
         ]
         backup = obs.backups.get(match_id)
         allowed = [sid for sid in candidates if sid != backup]
@@ -250,6 +281,44 @@ class AutopilotPolicy:
             for sid in pool
         )
         occupancy = total_active / total_slots if total_slots else 1.0
+
+        # 0) Partition awareness. A suspect server (missed beats, probe
+        #    still answering) means the absence of signal is a NETWORK
+        #    fact, not a server fact — so the policy stops acting on
+        #    absence: scale-down and drain-packing freeze until every
+        #    suspicion clears. Scale-up and burn preemption stay live
+        #    (adding capacity and moving load off a *paging* server are
+        #    safe under partition; both act on signals that arrived).
+        suspects = sorted(
+            sid for sid, s in servers.items() if self._is_suspect(s)
+        )
+        for sid in suspects:
+            if sid not in self._suspected:
+                acts.append(AutopilotAction(
+                    "partition_suspected", obs.tick,
+                    f"server {sid} missed "
+                    f"{servers[sid].missed_beats} beat(s) but its "
+                    "control-plane probe still answers: network suspect, "
+                    "not dead",
+                    server_id=sid,
+                ))
+        self._suspected = set(suspects)
+        if suspects and not self._degraded:
+            self._degraded = True
+            acts.append(AutopilotAction(
+                "degraded_enter", obs.tick,
+                f"suspect server(s) {suspects}: freezing scale-down and "
+                "drain-packing until the partition clears",
+            ))
+        elif not suspects and self._degraded:
+            self._degraded = False
+            acts.append(AutopilotAction(
+                "degraded_exit", obs.tick,
+                "no suspect servers remain; resuming normal elasticity",
+            ))
+        degraded = self._degraded
+        if degraded:
+            self.degraded_beats += 1
 
         # 1) Burn preemption — health outranks capacity.
         for sid in live:
@@ -351,8 +420,13 @@ class AutopilotPolicy:
                 self._refused.discard(("scale", "up"))
 
         # 3) Drain-pack progress: pack strictly before retire; retire only
-        #    once the draining server hosts nothing.
+        #    once the draining server hosts nothing. Frozen while
+        #    degraded — packing trusts occupancy arithmetic that a
+        #    partition has falsified, and a retire issued on stale
+        #    knowledge is unrecoverable.
         for sid in sorted(s for s in live if servers[s].draining):
+            if degraded:
+                break
             victims = sorted(
                 m for m, host in obs.placements.items() if host == sid
             )
@@ -394,6 +468,7 @@ class AutopilotPolicy:
             occupancy <= cfg.low_watermark
             and len(pool) > cfg.min_servers
             and not draining_open
+            and not degraded
         ):
             self._low_streak += 1
         else:
@@ -558,7 +633,11 @@ class BalancerFleet:
                 continue
             hb = m.info if m.info is not None else m.server.heartbeat()
             out[sid] = ServerSample.from_heartbeat(
-                hb, draining=getattr(m, "draining", False)
+                hb, draining=getattr(m, "draining", False),
+                missed_beats=getattr(m, "missed_beats", 0),
+                # In-process members have no separate probe path; alive
+                # membership IS the control-plane reachability signal.
+                reachable=bool(m.alive),
             )
         return out
 
@@ -660,6 +739,11 @@ class FleetAutopilot:
         self.actions: List[AutopilotAction] = []
         self.counts: Dict[str, int] = {}
 
+    @property
+    def degraded_beats(self) -> int:
+        """Ticks spent in partition-degraded mode (shrink frozen)."""
+        return self.policy.degraded_beats
+
     # -- anti-affinity bookkeeping ---------------------------------------
 
     def _assign_backups(
@@ -705,7 +789,9 @@ class FleetAutopilot:
             return bool(self.fleet.set_draining(a.server_id))
         if a.kind == "retire":
             return bool(self.fleet.retire(a.server_id))
-        return True  # refuse: the decision IS the act
+        # refuse / partition_suspected / degraded_enter / degraded_exit:
+        # the recorded decision IS the act.
+        return True
 
     def step(self, tick: int) -> List[AutopilotAction]:
         self.fleet.pump_migrations()

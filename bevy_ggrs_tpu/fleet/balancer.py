@@ -121,6 +121,12 @@ class FleetMember:
     draining: bool = False
     last_beat: Optional[float] = None
     info: Optional[proto.FleetHeartbeat] = None
+    # Monotonic-staleness liveness: ``last_beat`` only advances on a beat
+    # whose ``beat_seq`` is strictly newer than any seen (a REORDERED old
+    # beat carries no liveness information); ``missed_beats`` is the
+    # receiver-derived count of expected-but-unheard beats since.
+    last_beat_seq: int = -1
+    missed_beats: int = 0
 
 
 @dataclasses.dataclass
@@ -154,6 +160,7 @@ class Migration:
     total: int
     digest: int
     begun_dst_frames: int
+    epoch: int = 0
     chunks: Dict[int, bytes] = dataclasses.field(default_factory=dict)
     offer_seen: bool = False
     done_seen: bool = False
@@ -177,6 +184,8 @@ class FleetBalancer:
         page_refusal_threshold: int = 1,
         spec_hit_weight: float = 0.25,
         spec_waste_weight: float = 0.5,
+        dead_beats: int = 3,
+        reliable_wire: bool = True,
     ):
         import time as _time
 
@@ -204,12 +213,26 @@ class FleetBalancer:
         self.spec_waste_weight = float(spec_waste_weight)
         self.placements_refused_paging = 0
         self.placements_on_paging = 0
+        # Server-loss discipline: dead = ``dead_beats`` consecutive missed
+        # beats on a monotonically-stale liveness clock (one beat period is
+        # heartbeat_timeout / dead_beats), so neither a single lost beat
+        # nor a REORDERED stale one can flip a live server to dead.
+        self.dead_beats = max(1, int(dead_beats))
+        # Wrap member migration sockets in the reliable sublayer
+        # (transport/reliable.py) so type 18-21 frames survive chaos.
+        self.reliable_wire = bool(reliable_wire)
         self.members: Dict[int, FleetMember] = {}
         self.placements: Dict[int, Placement] = {}
         self._nonce = 0
+        # Fencing tokens: per-match epoch, bumped on every transfer
+        # attempt; a landing from a superseded epoch is refused without
+        # readmit (the newer attempt owns the match).
+        self._epochs: Dict[int, int] = {}
         self.migrations_begun = 0
         self.migrations_completed = 0
         self.migrations_aborted = 0
+        self.abort_reasons: Dict[str, int] = {}
+        self.epoch_fence_refusals = 0
         self.failovers = 0
         self.matches_recovered = 0
         self.matches_lost = 0
@@ -224,6 +247,13 @@ class FleetBalancer:
         sock=None,
         checkpoint_dir: Optional[str] = None,
     ) -> FleetMember:
+        if sock is not None and self.reliable_wire:
+            from bevy_ggrs_tpu.transport.reliable import ReliableSocket
+
+            if not isinstance(sock, ReliableSocket):
+                sock = ReliableSocket(
+                    sock, clock=self._clock, seed=int(server_id)
+                )
         m = FleetMember(
             server_id=int(server_id),
             server=server,
@@ -287,6 +317,7 @@ class FleetBalancer:
                 "server_id": sid,
                 "alive": m.alive,
                 "draining": m.draining,
+                "missed_beats": m.missed_beats,
                 "matches": sum(
                     1 for pl in self.placements.values()
                     if pl.server_id == sid
@@ -306,6 +337,17 @@ class FleetBalancer:
                 )
             rows.append(row)
         return rows
+
+    @property
+    def ctrl_retransmits(self) -> int:
+        """Reliable-sublayer retransmits across every member wire — the
+        chaos soak's 'the control plane actually fought packet loss'
+        witness."""
+        return sum(
+            int(getattr(m.sock, "retransmits", 0) or 0)
+            for m in self.members.values()
+            if m.sock is not None
+        )
 
     # -- heartbeats + death detection ------------------------------------
 
@@ -333,23 +375,42 @@ class FleetBalancer:
             member = self.members.get(msg.server_id)
             if member is None:
                 continue
+            delta = member.last_beat_seq - msg.beat_seq
+            if msg.beat_seq > 0 and 0 <= delta <= proto.BEAT_REORDER_WINDOW:
+                # Reordered stale beat: a seq we already advanced past
+                # must NOT refresh liveness (the false-positive fix's
+                # dual: no false NEGATIVES from old beats either).
+                # Bounded window, not a bare compare: heartbeats travel
+                # unenveloped, so a corrupted beat_seq with a high bit
+                # flipped would otherwise poison the floor forever; a
+                # far-off seq resets it instead (self-healing).
+                self.metrics.count("fleet_heartbeats_stale")
+                continue
+            member.last_beat_seq = msg.beat_seq
             member.last_beat = now
+            member.missed_beats = 0
             member.info = msg
             applied += 1
             self.metrics.count("fleet_heartbeats_rx")
         return applied
 
     def check(self, now: Optional[float] = None) -> List[int]:
-        """Declare members dead after ``heartbeat_timeout`` of CONTINUOUS
-        silence; returns newly-dead server ids (the caller triggers
-        :meth:`failover` — detection and recovery are separate acts so a
-        harness can interleave them with frame serving)."""
+        """Declare members dead after ``dead_beats`` CONSECUTIVE missed
+        beats (one beat period = ``heartbeat_timeout / dead_beats``, so
+        the total silence budget is unchanged); returns newly-dead server
+        ids (the caller triggers :meth:`failover` — detection and
+        recovery are separate acts so a harness can interleave them with
+        frame serving). Because :meth:`pump` refuses to let a reordered
+        stale beat advance ``last_beat``, the missed count is monotone
+        under silence — one lucky old datagram cannot reset it."""
         now = self._clock() if now is None else float(now)
+        period = self.heartbeat_timeout / self.dead_beats
         dead: List[int] = []
         for m in self.members.values():
             if not m.alive or m.last_beat is None:
                 continue
-            if now - m.last_beat > self.heartbeat_timeout:
+            m.missed_beats = max(0, int((now - m.last_beat) / period))
+            if m.missed_beats >= self.dead_beats:
                 m.alive = False
                 dead.append(m.server_id)
                 self.metrics.count("fleet_servers_dead")
@@ -357,6 +418,7 @@ class FleetBalancer:
                     "fleet_server_dead",
                     server=m.server_id,
                     silent_for=now - m.last_beat,
+                    missed_beats=m.missed_beats,
                 )
         return dead
 
@@ -492,6 +554,10 @@ class FleetBalancer:
             raise ValueError("migration destination is the source")
         self._nonce = (self._nonce + 1) & 0xFFFFFFFF
         nonce = self._nonce
+        # Bump the match's fencing token: this attempt supersedes every
+        # earlier one, whose frames/landings are now refusable by epoch.
+        epoch = self._epochs.get(pl.match_id, 0) + 1
+        self._epochs[pl.match_id] = epoch
         with self.tracer.span(
             "fleet_migrate",
             phase="begin",
@@ -528,7 +594,8 @@ class FleetBalancer:
             src.sock.send_to(
                 proto.encode(
                     proto.MigrateOffer(
-                        nonce, pl.match_id, ticket.frame, total, digest
+                        nonce, pl.match_id, ticket.frame, total, digest,
+                        epoch,
                     )
                 ),
                 dst.addr,
@@ -543,13 +610,14 @@ class FleetBalancer:
                             total,
                             zlib.crc32(payload) & 0xFFFFFFFF,
                             payload,
+                            epoch,
                         )
                     ),
                     dst.addr,
                 )
                 self.metrics.count("fleet_migrate_bytes", len(payload))
             src.sock.send_to(
-                proto.encode(proto.MigrateDone(nonce, ticket.frame, 1)),
+                proto.encode(proto.MigrateDone(nonce, ticket.frame, 1, epoch)),
                 dst.addr,
             )
         self.migrations_begun += 1
@@ -565,6 +633,7 @@ class FleetBalancer:
             total=total,
             digest=digest,
             begun_dst_frames=dst.server.frames_served,
+            epoch=epoch,
         )
 
     def _abort_migration(self, mig: Migration, reason: str) -> None:
@@ -578,9 +647,29 @@ class FleetBalancer:
         pl.server_id, pl.handle = src.server_id, handle
         mig.resolved, mig.aborted = True, True
         self.migrations_aborted += 1
+        self.abort_reasons[reason] = self.abort_reasons.get(reason, 0) + 1
         self.metrics.count("fleet_migrations_aborted")
         self.tracer.instant(
             "fleet_migrate_abort", match=mig.match_id, reason=reason
+        )
+
+    def _refuse_landing(self, mig: Migration, reason: str) -> None:
+        """Epoch fence: a landing from a superseded transfer attempt is
+        refused WITHOUT readmitting the retained ticket — the newer epoch
+        owns the match, and resurrecting a stale ticket at the source
+        would be exactly the duplicate-match split-brain the fence
+        exists to kill. Typed event, no match lost (the live copy is the
+        newer attempt's)."""
+        mig.resolved, mig.aborted = True, True
+        self.epoch_fence_refusals += 1
+        self.abort_reasons[reason] = self.abort_reasons.get(reason, 0) + 1
+        self.metrics.count("fleet_epoch_fence_refusals")
+        self.tracer.instant(
+            "fleet_epoch_fence",
+            match=mig.match_id,
+            reason=reason,
+            epoch=mig.epoch,
+            current=self._epochs.get(mig.match_id, 0),
         )
 
     def complete_migration(self, mig: Migration) -> Optional[MatchHandle]:
@@ -593,6 +682,11 @@ class FleetBalancer:
         abort (check ``mig.aborted``). Call repeatedly between frames."""
         if mig.resolved:
             return mig.dst_handle
+        if mig.epoch < self._epochs.get(mig.match_id, 0):
+            # This whole attempt was superseded (a newer begin_migration
+            # bumped the fence) — refuse it outright, readmitting nothing.
+            self._refuse_landing(mig, "epoch_fence")
+            return None
         src = self.members[mig.src_id]
         dst = self.members[mig.dst_id]
         for _addr, data in dst.sock.receive_all():
@@ -603,7 +697,12 @@ class FleetBalancer:
                 mig.offer_seen = True
                 accept = bool(dst.server.free_slot_handles())
                 dst.sock.send_to(
-                    proto.encode(proto.MigrateAccept(mig.nonce, accept)),
+                    proto.encode(
+                        proto.MigrateAccept(
+                            mig.nonce, accept, msg.epoch,
+                            0 if accept else proto.MIG_REFUSE_CAPACITY,
+                        )
+                    ),
                     src.addr,
                 )
                 if not accept:
@@ -637,6 +736,12 @@ class FleetBalancer:
             rec = unpack_match_record(dst.server.state_codec(), blob)
         except ValueError:
             self._abort_migration(mig, "record_digest")
+            return None
+        if mig.epoch < self._epochs.get(mig.match_id, 0):
+            # Fence the LANDING too: the blob arrived whole but a newer
+            # attempt owns the match now — landing it would host the
+            # match twice.
+            self._refuse_landing(mig, "epoch_fence")
             return None
         pl = self.placements[mig.match_id]
         with self.tracer.span(

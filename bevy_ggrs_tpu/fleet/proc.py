@@ -67,6 +67,12 @@ DEFAULT_CONFIG: Dict = {
     "checkpoint_interval": 60,
     "obs_dir": None,
     "spec_on": True,
+    # Chaos on the child's real UDP socket: a ChaosPlan as JSON (see
+    # chaos/plan.py) applied to every outgoing datagram — heartbeats and
+    # migration frames alike. `chaos_t0` is the shared wall-clock origin
+    # (time.time()) so directive windows line up across processes.
+    "chaos_plan": None,
+    "chaos_t0": None,
     # Wall-clock (NOT frames: a free-running child serves thousands of
     # frames per second, and a frame-counted deadline would abort-and-
     # resume an outgoing transfer the destination has already admitted —
@@ -125,6 +131,7 @@ class _Child:
         from bevy_ggrs_tpu.obs.provenance import ProvenanceLog, SidecarSocket
         from bevy_ggrs_tpu.obs.trace import SpanTracer
         from bevy_ggrs_tpu.serve.server import MatchServer
+        from bevy_ggrs_tpu.transport.reliable import ReliableSocket
         from bevy_ggrs_tpu.transport.udp import UdpSocket
         from bevy_ggrs_tpu.utils.metrics import Metrics
         from bevy_ggrs_tpu.utils.xla_cache import compile_counters
@@ -137,12 +144,39 @@ class _Child:
         self.matches: Dict[int, dict] = {}  # mid -> {handle, session}
         self.outgoing: Dict[int, dict] = {}  # nonce -> src-side transfer
         self.incoming: Dict[int, dict] = {}  # nonce -> dst-side transfer
+        # Highest migration epoch engaged per match — the child half of
+        # the split-brain fence (the parent is the epoch authority).
+        self.match_epochs: Dict[int, int] = {}
+        self.fence_refusals = 0
         self._stdin_buf = b""
         os.set_blocking(sys.stdin.fileno(), False)
 
         # Ephemeral-port data plane; pure-python so local_port is cheap.
         self.sock = UdpSocket(0, "127.0.0.1", use_native=False)
         self.mig_port = self.sock.local_port()
+        inner = self.sock
+        self.chaos = None
+        if cfg.get("chaos_plan"):
+            from bevy_ggrs_tpu.chaos.plan import ChaosPlan
+            from bevy_ggrs_tpu.chaos.socket import ChaosSocket
+
+            plan = ChaosPlan.from_json(cfg["chaos_plan"])
+            origin = float(cfg.get("chaos_t0") or time.time())
+            # addr = server_id, not the ephemeral UDP tuple: Partition
+            # directives can then name server ids that exist at
+            # plan-generation time, and the per-socket fault RNG stream
+            # is stable across runs. Bind the origin as a default arg —
+            # a plain closure would see later rebindings of the local.
+            self.chaos = ChaosSocket(
+                inner, plan,
+                clock=lambda _o=origin: time.time() - _o,
+                addr=self.sid,
+            )
+            inner = self.chaos
+        # Reliable sublayer ABOVE the chaos injector (acks and
+        # retransmits must cross the faulty wire too); heartbeats pass
+        # through unenveloped — the next beat is their retry.
+        self.rel = ReliableSocket(inner, seed=self.sid)
         self.prov = None
         tracer = None
         ledger = None
@@ -156,7 +190,7 @@ class _Child:
             ledger = SpeculationLedger(
                 component=f"srv{self.sid}-spec", pid=700 + self.sid
             )
-        wire = SidecarSocket(self.sock, self.prov) if self.prov else self.sock
+        wire = SidecarSocket(self.rel, self.prov) if self.prov else self.rel
         self.wire = wire
 
         parent = cfg.get("parent")
@@ -270,6 +304,7 @@ class _Child:
 
         mid = int(cmd["match"])
         nonce = int(cmd["nonce"])
+        epoch = int(cmd.get("epoch", 0))
         dst = (str(cmd["dst"][0]), int(cmd["dst"][1]))
         m = self.matches.pop(mid, None)
         if m is None:
@@ -278,6 +313,10 @@ class _Child:
                 reason="unknown_match",
             )
             return
+        if epoch:
+            self.match_epochs[mid] = max(
+                self.match_epochs.get(mid, 0), epoch
+            )
         session_state = None
         sd = getattr(m["session"], "state_dict", None)
         if sd is not None:
@@ -304,7 +343,9 @@ class _Child:
         total = len(chunks)
         self.wire.send_to(
             proto.encode(
-                proto.MigrateOffer(nonce, mid, ticket.frame, total, digest)
+                proto.MigrateOffer(
+                    nonce, mid, ticket.frame, total, digest, epoch
+                )
             ),
             dst,
         )
@@ -313,13 +354,14 @@ class _Child:
                 proto.encode(
                     proto.MigrateChunk(
                         nonce, ticket.frame, seq, total,
-                        zlib.crc32(payload) & 0xFFFFFFFF, payload,
+                        zlib.crc32(payload) & 0xFFFFFFFF, payload, epoch,
                     )
                 ),
                 dst,
             )
         self.wire.send_to(
-            proto.encode(proto.MigrateDone(nonce, ticket.frame, 1)), dst
+            proto.encode(proto.MigrateDone(nonce, ticket.frame, 1, epoch)),
+            dst,
         )
         self.outgoing[nonce] = {
             "match": mid,
@@ -327,22 +369,42 @@ class _Child:
             "session": m["session"],
             "inputs": m["inputs"],
             "ticket": ticket,
+            "epoch": epoch,
             "deadline": time.monotonic() + self.cfg["migrate_timeout_s"],
         }
 
     def _abort_outgoing(self, nonce: int, reason: str) -> None:
         out = self.outgoing.pop(nonce)
-        handle = self.server.resume_match(
-            out["session"], out["inputs"], out["ticket"],
-            handle=out["handle"],
-        )
+        try:
+            handle = self.server.resume_match(
+                out["session"], out["inputs"], out["ticket"],
+                handle=out["handle"],
+            )
+        except RuntimeError:
+            # The original slot was reused while the transfer was in
+            # flight (a chaos-stretched timeout leaves a long window).
+            # Slot identity is bookkeeping, not state — any free slot
+            # preserves the match.
+            try:
+                handle = self.server.resume_match(
+                    out["session"], out["inputs"], out["ticket"],
+                )
+            except RuntimeError:
+                # Nowhere to land it: surface a typed loss instead of
+                # crashing the child; the parent holds checkpoints.
+                self._emit(
+                    event="resume_failed", match=out["match"],
+                    nonce=nonce, reason=reason,
+                )
+                return
         self.matches[out["match"]] = {
             "handle": handle, "session": out["session"],
             "inputs": out["inputs"],
         }
         self._emit(
             event="migrate_abort", match=out["match"], nonce=nonce,
-            reason=reason,
+            reason=reason, resumed=True,
+            handle=[handle.group, handle.slot],
         )
 
     # -- migration wire (dst side + src acks) ----------------------------
@@ -355,16 +417,58 @@ class _Child:
             if msg is None:
                 continue
             if isinstance(msg, proto.MigrateOffer):
-                accept = (
-                    not self.draining
-                    and bool(self.server.free_slot_handles())
-                    and msg.match_id not in self.matches
-                )
+                if msg.nonce in self.incoming:
+                    # Duplicated offer for a transfer already underway
+                    # (the reliable layer dedups envelopes, but a raw
+                    # duplicate can still arrive): never reset chunk
+                    # state, just re-affirm the accept.
+                    self.wire.send_to(
+                        proto.encode(
+                            proto.MigrateAccept(msg.nonce, 1, msg.epoch, 0)
+                        ),
+                        addr,
+                    )
+                    continue
+                refuse = None
+                if msg.epoch and msg.epoch < self.match_epochs.get(
+                    msg.match_id, 0
+                ):
+                    # Stale epoch: this offer belongs to a superseded
+                    # migration attempt — admitting it would double-host
+                    # the match.
+                    refuse = proto.MIG_REFUSE_EPOCH
+                    self.fence_refusals += 1
+                    self._emit(
+                        event="offer_refused", match=msg.match_id,
+                        nonce=msg.nonce, reason="epoch_fence",
+                        epoch=msg.epoch,
+                        current=self.match_epochs.get(msg.match_id, 0),
+                    )
+                elif msg.match_id in self.matches:
+                    refuse = proto.MIG_REFUSE_DUP
+                    self._emit(
+                        event="offer_refused", match=msg.match_id,
+                        nonce=msg.nonce, reason="duplicate_match",
+                        epoch=msg.epoch,
+                    )
+                elif self.draining or not self.server.free_slot_handles():
+                    refuse = proto.MIG_REFUSE_CAPACITY
+                accept = refuse is None
                 self.wire.send_to(
-                    proto.encode(proto.MigrateAccept(msg.nonce, int(accept))),
+                    proto.encode(
+                        proto.MigrateAccept(
+                            msg.nonce, int(accept), msg.epoch,
+                            0 if accept else refuse,
+                        )
+                    ),
                     addr,
                 )
                 if accept:
+                    if msg.epoch:
+                        self.match_epochs[msg.match_id] = max(
+                            self.match_epochs.get(msg.match_id, 0),
+                            msg.epoch,
+                        )
                     self.incoming[msg.nonce] = {
                         "offer": msg,
                         "src": addr,
@@ -376,7 +480,9 @@ class _Child:
                 inc = self.incoming.get(msg.nonce)
                 if inc is None:
                     continue
-                if zlib.crc32(msg.payload) & 0xFFFFFFFF != msg.crc:
+                if msg.epoch != inc["offer"].epoch:
+                    inc["bad"] = "epoch_mismatch"
+                elif zlib.crc32(msg.payload) & 0xFFFFFFFF != msg.crc:
                     inc["bad"] = "chunk_crc"
                 else:
                     inc["chunks"][msg.seq] = msg.payload
@@ -395,7 +501,18 @@ class _Child:
                         self._abort_outgoing(msg.nonce, "dst_failed")
             elif isinstance(msg, proto.MigrateAccept):
                 if msg.nonce in self.outgoing and not msg.accept:
-                    self._abort_outgoing(msg.nonce, "offer_refused")
+                    if msg.reason == proto.MIG_REFUSE_EPOCH:
+                        # The destination has seen a newer epoch for this
+                        # match: OUR retained copy is the stale one, and
+                        # resuming it would double-host. Drop it instead.
+                        out = self.outgoing.pop(msg.nonce)
+                        self.fence_refusals += 1
+                        self._emit(
+                            event="migrate_abort", match=out["match"],
+                            nonce=msg.nonce, reason="epoch_fence",
+                        )
+                    else:
+                        self._abort_outgoing(msg.nonce, "offer_refused")
 
     def _finish_incoming(self, nonce: int) -> None:
         from bevy_ggrs_tpu.relay.delta import payload_digest
@@ -407,7 +524,9 @@ class _Child:
 
         def fail(reason: str) -> None:
             self.wire.send_to(
-                proto.encode(proto.MigrateDone(nonce, offer.frame, 0)),
+                proto.encode(
+                    proto.MigrateDone(nonce, offer.frame, 0, offer.epoch)
+                ),
                 inc["src"],
             )
             self._emit(
@@ -440,13 +559,16 @@ class _Child:
             "handle": handle, "session": session, "inputs": inputs,
         }
         self.wire.send_to(
-            proto.encode(proto.MigrateDone(nonce, rec["frame"], 1)),
+            proto.encode(
+                proto.MigrateDone(nonce, rec["frame"], 1, offer.epoch)
+            ),
             inc["src"],
         )
         self._emit(
             event="migrated_in", match=mid, nonce=nonce,
             group=handle.group, slot=handle.slot, frame=int(rec["frame"]),
             stall_frames=self.server.frames_served - inc["begun_frames"],
+            epoch=offer.epoch,
         )
 
     # -- status / shutdown -----------------------------------------------
@@ -468,6 +590,12 @@ class _Child:
             evictions=self.server.evictions_total,
             compiles=self._compiles(),
             draining=self.draining,
+            ctrl_retransmits=self.rel.retransmits,
+            ctrl_crc_drops=self.rel.crc_drops,
+            ctrl_dups_dropped=self.rel.duplicates_dropped,
+            ctrl_gave_up=self.rel.gave_up,
+            fence_refusals=self.fence_refusals,
+            chaos_faults=len(self.chaos.faults) if self.chaos else 0,
         )
 
     def _shutdown(self) -> None:
@@ -489,6 +617,12 @@ class _Child:
             frames=self.server.frames_served,
             compiles=self._compiles(),
             faults=self.server.faults_total,
+            ctrl_retransmits=self.rel.retransmits,
+            ctrl_crc_drops=self.rel.crc_drops,
+            ctrl_dups_dropped=self.rel.duplicates_dropped,
+            ctrl_gave_up=self.rel.gave_up,
+            fence_refusals=self.fence_refusals,
+            chaos_faults=len(self.chaos.faults) if self.chaos else 0,
             artifacts=artifacts,
         )
         self.running = False
@@ -683,6 +817,9 @@ class _ProcMember:
     info: object = None  # last decoded FleetHeartbeat
     status: Optional[dict] = None
     last_beat: Optional[float] = None
+    last_beat_seq: int = -1
+    missed_beats: int = 0
+    suspect: bool = False
     first_beat_s: Optional[float] = None
     alive: bool = True
     draining: bool = False
@@ -704,7 +841,12 @@ class ProcFleet:
         obs_dir: Optional[str] = None,
         clock: Callable[[], float] = time.monotonic,
         failover_timeout: float = 60.0,
+        dead_beats: int = 3,
+        suspect_factor: int = 3,
+        chaos_plan=None,
+        chaos_t0: Optional[float] = None,
     ):
+        from bevy_ggrs_tpu.transport.reliable import ReliableSocket
         from bevy_ggrs_tpu.transport.udp import UdpSocket
 
         self.root_dir = root_dir
@@ -712,9 +854,20 @@ class ProcFleet:
         self.base_config = dict(base_config or {})
         self.heartbeat_timeout = float(heartbeat_timeout)
         self.failover_timeout = float(failover_timeout)
+        self.dead_beats = max(1, int(dead_beats))
+        # A silent-but-reachable child (the partition signature) is only
+        # declared dead after suspect_factor x the normal silence budget
+        # — the wedged-child backstop behind the suspect state.
+        self.suspect_factor = max(1, int(suspect_factor))
+        self.chaos_plan = chaos_plan  # ChaosPlan | JSON str | None
+        self.chaos_t0 = chaos_t0
         self.obs_dir = obs_dir
         self.clock = clock
-        self.sock = UdpSocket(0, "127.0.0.1", use_native=False)
+        # Reliable sublayer on the parent's sock too: failover shipments
+        # are migration frames and children ack/retransmit envelopes.
+        self.sock = ReliableSocket(
+            UdpSocket(0, "127.0.0.1", use_native=False), seed=0x5EED
+        )
         self.port = self.sock.local_port()
         self.members: Dict[int, _ProcMember] = {}
         self.book: Dict[int, int] = {}  # match -> server_id
@@ -722,6 +875,11 @@ class ProcFleet:
         self._nonce = 0
         # nonce -> in-flight transfer ({match, src, dst, failover, deadline})
         self._migrations: Dict[int, dict] = {}
+        # match -> current migration epoch; the parent is the sole epoch
+        # authority, bumping on every migrate()/failover shipment so a
+        # stale attempt can never land after its successor.
+        self._epochs: Dict[int, int] = {}
+        self.epoch_fence_refusals = 0
         self._codec = None
         self.events: List[dict] = []
         self.stall_frames: List[int] = []
@@ -766,6 +924,14 @@ class ProcFleet:
             checkpoint_dir=ck,
             obs_dir=self.obs_dir,
         )
+        if self.chaos_plan is not None:
+            plan = self.chaos_plan
+            cfg["chaos_plan"] = (
+                plan if isinstance(plan, str) else plan.to_json()
+            )
+            if self.chaos_t0 is None:
+                self.chaos_t0 = time.time()
+            cfg["chaos_t0"] = self.chaos_t0
         proc = ServerProcess(
             sid, cfg,
             stderr_path=os.path.join(self.root_dir, f"srv{sid}.stderr.log"),
@@ -801,10 +967,29 @@ class ProcFleet:
                 m = self.members.get(msg.server_id)
                 if m is None or not m.alive:
                     continue
+                delta = m.last_beat_seq - msg.beat_seq
+                if msg.beat_seq > 0 and 0 <= delta <= proto.BEAT_REORDER_WINDOW:
+                    # A reordered stale beat must not refresh liveness:
+                    # only monotonically newer beats count, so a delayed
+                    # burst can't mask real silence (beat_seq == 0 is a
+                    # legacy sender — no filtering). Staleness is a
+                    # bounded WINDOW, not a bare compare: a corrupted
+                    # beat that slipped decode with a high bit flipped
+                    # in beat_seq would otherwise poison last_beat_seq
+                    # forever; a far-off seq instead resets the floor
+                    # (restart/corruption self-heal) below.
+                    continue
+                m.last_beat_seq = msg.beat_seq
                 if m.info is None:
                     m.first_beat_s = now - m.spawn_t0
                     self.scale_up_s.append(m.first_beat_s)
                 m.info, m.last_beat = msg, now
+                m.missed_beats = 0
+                if m.suspect:
+                    m.suspect = False
+                    self.events.append({
+                        "event": "suspect_cleared", "server": msg.server_id,
+                    })
             elif isinstance(msg, proto.MigrateDone):
                 # Verdict on a parent-sourced failover transfer.
                 ent = self._migrations.get(msg.nonce)
@@ -861,10 +1046,38 @@ class ProcFleet:
             self.admissions_rejected += 1
         elif kind == "migrated_in":
             mid = int(ev["match"])
-            self.handles[mid] = (int(ev["group"]), int(ev["slot"]))
             nonce = int(ev["nonce"])
+            epoch = int(ev.get("epoch", 0))
+            if epoch and epoch < self._epochs.get(mid, 0):
+                # Stale landing from a superseded attempt: a newer epoch
+                # owns this match elsewhere. Refuse the landing and order
+                # the zombie copy dropped — updating book/handles here
+                # would be the split-brain.
+                self._migrations.pop(nonce, None)
+                self.epoch_fence_refusals += 1
+                m.process.send(cmd="retire", match=mid)
+                self.events.append({
+                    "event": "epoch_fence", "match": mid, "server": sid,
+                    "epoch": epoch, "current": self._epochs.get(mid, 0),
+                })
+                return
             ent = self._migrations.pop(nonce, None)
-            if ent is not None and not ent.get("failover"):
+            if ent is None:
+                # A landing from an attempt the parent no longer
+                # tracks: the source timed out, aborted, and resumed
+                # its retained copy — which has been serving frames
+                # since. The late landing is the stale copy; admitting
+                # it would double-host the match. Retire it at the
+                # destination and leave book/handles on the source.
+                self.epoch_fence_refusals += 1
+                m.process.send(cmd="retire", match=mid)
+                self.events.append({
+                    "event": "late_landing_refused", "match": mid,
+                    "server": sid, "nonce": nonce,
+                })
+                return
+            self.handles[mid] = (int(ev["group"]), int(ev["slot"]))
+            if not ent.get("failover"):
                 self.book[mid] = ent["dst"]
                 self.migrations_completed += 1
                 self.stall_frames.append(int(ev["stall_frames"]))
@@ -876,31 +1089,96 @@ class ProcFleet:
             # failover completion is driven by MigrateDone at our sock
         elif kind == "migrate_abort":
             nonce = int(ev.get("nonce", -1))
+            mid = ev.get("match")
             ent = self._migrations.pop(nonce, None)
             if ent is not None:
                 self.migrations_aborted += 1
+                if ev.get("resumed") and ev.get("handle") and mid is not None:
+                    # Abort-resume may have landed in a different slot
+                    # (the original was reused mid-flight).
+                    self.handles[int(mid)] = tuple(ev["handle"])
+            elif (
+                ev.get("resumed")
+                and mid is not None
+                and self.book.get(int(mid)) not in (None, sid)
+            ):
+                # The transfer actually landed (migrated_in moved the
+                # book to the destination) before the source's timeout
+                # abort resumed its retained copy: that copy is the
+                # zombie — retire it where it just resumed.
+                self.epoch_fence_refusals += 1
+                m.process.send(cmd="retire", match=int(mid))
+                self.events.append({
+                    "event": "stale_abort_retired", "match": int(mid),
+                    "server": sid, "nonce": nonce,
+                })
+            if ev.get("reason") == "epoch_fence":
+                self.epoch_fence_refusals += 1
             self.events.append({
-                "event": "migrate_abort", "match": ev.get("match"),
+                "event": "migrate_abort", "match": mid,
                 "reason": ev.get("reason"), "server": sid,
+            })
+        elif kind == "resume_failed":
+            # An aborted outgoing transfer found no slot to resume into
+            # (original reused, server since filled): the running copy
+            # is gone, but the checkpoint tier still has the match —
+            # the same recovery the fleet uses for a dead server.
+            mid = int(ev["match"])
+            self._migrations.pop(int(ev.get("nonce", -1)), None)
+            self.events.append({
+                "event": "resume_failed", "match": mid, "server": sid,
+            })
+            if self.book.get(mid) == sid:
+                self._recover_match(mid, exclude=sid)
+        elif kind == "offer_refused":
+            if ev.get("reason") == "epoch_fence":
+                self.epoch_fence_refusals += 1
+            self.events.append({
+                "event": "offer_refused", "server": sid,
+                "match": ev.get("match"), "reason": ev.get("reason"),
             })
         elif kind == "bye":
             m.artifacts = ev.get("artifacts") or {}
+            # Fold the child's final counters into its last status so the
+            # fleet aggregates survive shutdown.
+            m.status = {**(m.status or {}), **ev}
 
     # -- death + failover ------------------------------------------------
 
     def check(self, now: Optional[float] = None) -> List[int]:
-        """Heartbeat-timeout death detection (the fleet's one crash
-        signal — a SIGKILLed child simply stops beating)."""
+        """Partition-aware death detection. ``dead_beats`` missed beats
+        (same total silence budget as the old wall-clock timeout) mark a
+        member *suspect*; suspicion upgrades to death only when the
+        control-plane probe fails too (the child process is gone — a
+        SIGKILLed child both stops beating and fails the probe) or the
+        silence outlasts ``suspect_factor`` x the budget (the
+        wedged-child backstop). A mere network partition around a
+        healthy child therefore never triggers a failover that would
+        double-host its matches."""
         now = self.clock() if now is None else now
+        period = self.heartbeat_timeout / self.dead_beats
         dead: List[int] = []
         for sid, m in sorted(self.members.items()):
             if not m.alive or m.retiring:
                 continue
+            if m.last_beat is not None:
+                m.missed_beats = max(
+                    0, int((now - m.last_beat) / period)
+                )
             silent = (
                 m.last_beat is not None
-                and now - m.last_beat > self.heartbeat_timeout
+                and m.missed_beats >= self.dead_beats
             )
             exited_early = m.info is None and not m.process.alive()
+            if silent and m.process.alive():
+                if m.missed_beats < self.dead_beats * self.suspect_factor:
+                    if not m.suspect:
+                        m.suspect = True
+                        self.events.append({
+                            "event": "partition_suspected", "server": sid,
+                            "missed_beats": m.missed_beats,
+                        })
+                    continue
             if silent or exited_early:
                 m.alive = False
                 dead.append(sid)
@@ -995,6 +1273,43 @@ class ProcFleet:
             cands, key=lambda s: (heartbeat_score(self.members[s].info), s)
         )
 
+    def _recover_match(self, mid: int, exclude: int) -> bool:
+        """Re-seed ONE booked match from its host's last on-disk
+        checkpoint onto another child — the per-match slice of
+        :meth:`failover`, without declaring the host dead. Used when a
+        live child reports it cannot keep a match it still owns (an
+        aborted transfer with no slot left to resume into)."""
+        from bevy_ggrs_tpu.serve.faults import (
+            ServerCheckpointer,
+            load_checkpoint_matches,
+        )
+
+        member = self.members.get(exclude)
+        rec = None
+        path = (
+            ServerCheckpointer(member.checkpoint_dir).latest()
+            if member is not None and member.checkpoint_dir
+            else None
+        )
+        if path is not None:
+            codec = self._parent_codec()
+            key = self.handles.get(mid)
+            for r in load_checkpoint_matches(path, codec):
+                if r["key"] == key:
+                    rec = r
+                    break
+        dst = self._failover_dst(mid, exclude, {})
+        if rec is None or rec["kind"] != "synctest" or dst is None:
+            self.book.pop(mid, None)
+            self.matches_lost += 1
+            self.events.append({
+                "event": "lost", "match": mid,
+                "reason": "no_checkpoint" if rec is None else "no_dst",
+            })
+            return False
+        self._ship_record(mid, rec, dst)
+        return True
+
     def _ship_record(self, mid: int, rec: dict, dst_id: int) -> None:
         from bevy_ggrs_tpu.relay.delta import payload_digest
         from bevy_ggrs_tpu.serve.server import MatchHandle
@@ -1024,11 +1339,13 @@ class ProcFleet:
         ] or [b""]
         self._nonce = (self._nonce + 1) & 0xFFFFFFFF
         nonce = self._nonce
+        epoch = self._epochs.get(mid, 0) + 1
+        self._epochs[mid] = epoch
         addr = self.members[dst_id].mig_addr
         self.sock.send_to(
             proto.encode(
                 proto.MigrateOffer(
-                    nonce, mid, rec["frame"], len(chunks), digest
+                    nonce, mid, rec["frame"], len(chunks), digest, epoch
                 )
             ),
             addr,
@@ -1038,16 +1355,18 @@ class ProcFleet:
                 proto.encode(
                     proto.MigrateChunk(
                         nonce, rec["frame"], seq, len(chunks),
-                        zlib.crc32(payload) & 0xFFFFFFFF, payload,
+                        zlib.crc32(payload) & 0xFFFFFFFF, payload, epoch,
                     )
                 ),
                 addr,
             )
         self.sock.send_to(
-            proto.encode(proto.MigrateDone(nonce, rec["frame"], 1)), addr
+            proto.encode(proto.MigrateDone(nonce, rec["frame"], 1, epoch)),
+            addr,
         )
         self._migrations[nonce] = {
             "match": mid, "src": None, "dst": dst_id, "failover": True,
+            "epoch": epoch,
             "deadline": self.clock() + self.failover_timeout,
         }
 
@@ -1095,7 +1414,9 @@ class ProcFleet:
             if not m.alive or m.retiring or m.info is None:
                 continue
             out[sid] = ServerSample.from_heartbeat(
-                m.info, draining=m.draining
+                m.info, draining=m.draining,
+                missed_beats=m.missed_beats,
+                reachable=m.process.alive(),
             )
         return out
 
@@ -1125,12 +1446,16 @@ class ProcFleet:
             return False
         self._nonce = (self._nonce + 1) & 0xFFFFFFFF
         nonce = self._nonce
+        epoch = self._epochs.get(mid, 0) + 1
+        self._epochs[mid] = epoch
         if not srcm.process.send(
-            cmd="migrate", match=mid, dst=list(dstm.mig_addr), nonce=nonce
+            cmd="migrate", match=mid, dst=list(dstm.mig_addr), nonce=nonce,
+            epoch=epoch,
         ):
             return False
         self._migrations[nonce] = {
             "match": mid, "src": src, "dst": int(dst_id), "failover": False,
+            "epoch": epoch,
             "deadline": self.clock() + self.failover_timeout,
         }
         return True
@@ -1164,6 +1489,24 @@ class ProcFleet:
 
     # -- observability ---------------------------------------------------
 
+    def _child_counter(self, key: str) -> int:
+        return sum(
+            int((m.status or {}).get(key, 0))
+            for m in self.members.values()
+        )
+
+    @property
+    def ctrl_retransmits(self) -> int:
+        """Reliable-sublayer retransmits fleet-wide: the parent sock's
+        live counter plus every child's last-reported one."""
+        return getattr(self.sock, "retransmits", 0) + self._child_counter(
+            "ctrl_retransmits"
+        )
+
+    @property
+    def chaos_faults(self) -> int:
+        return self._child_counter("chaos_faults")
+
     def fleet_rows(self) -> List[dict]:
         rows = []
         for sid, m in sorted(self.members.items()):
@@ -1171,6 +1514,8 @@ class ProcFleet:
                 "server_id": sid,
                 "alive": m.alive and not m.retiring,
                 "draining": m.draining,
+                "missed_beats": m.missed_beats,
+                "suspect": m.suspect,
                 "matches": sum(
                     1 for s in self.book.values() if s == sid
                 ),

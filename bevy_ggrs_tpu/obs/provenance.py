@@ -65,6 +65,8 @@ _TYPE_TAGS = {
     protocol.T_MIGRATE_CHUNK: "migrate_chunk",
     protocol.T_MIGRATE_DONE: "migrate_done",
     protocol.T_FLEET_HEARTBEAT: "fleet_heartbeat",
+    protocol.T_CTRL_FRAME: "ctrl_frame",
+    protocol.T_CTRL_ACK: "ctrl_ack",
 }
 
 
@@ -112,6 +114,12 @@ def _classify(data: bytes) -> Tuple[str, Optional[int], Optional[str]]:
             frame = protocol._MIG_DONE.unpack_from(body)[1]
         elif mtype == protocol.T_RELAY_FORWARD:
             inner, frame, _ = _classify(body[protocol._RELAY_FWD.size:])
+        elif mtype == protocol.T_CTRL_FRAME:
+            # Reliable-sublayer envelope: classify THROUGH it — the
+            # envelope is transport plumbing, and a tap below the
+            # ReliableSocket should attribute the inner control frame
+            # exactly as if the sublayer weren't there.
+            return _classify(body[protocol._CTRL_FRAME.size:])
     except Exception:
         pass
     return tag, frame, inner

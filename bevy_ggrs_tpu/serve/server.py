@@ -338,6 +338,10 @@ class MatchServer:
             ),
             spec_hit_permille=spec_hit_permille,
             spec_waste_permille=spec_waste_permille,
+            # Monotonic send counter (1-based on the wire): the balancer
+            # refuses to let a beat whose seq it already advanced past
+            # refresh liveness, so chaos reorder can't fake freshness.
+            beat_seq=self.heartbeats_sent + 1,
         )
 
     def free_slot_handles(self) -> List[MatchHandle]:

@@ -40,6 +40,17 @@ MAGIC = 0x47  # 'G'
 # on with silently different recovery economics.
 VERSION = 4
 
+# Heartbeat staleness is a bounded reorder window on beat_seq, not a bare
+# monotonic compare. Heartbeats travel unenveloped (the next beat is their
+# retry), so a corrupted datagram that slips the 3-byte header check can
+# carry a beat_seq with a high bit flipped; with a bare `seq <= last`
+# guard that single beat would poison the receiver's floor and every
+# later genuine beat would read as stale — a permanently "silent" live
+# server. Inside the window a lower seq is a genuinely reordered stale
+# beat (dropped); beyond it the receiver resets its floor (corruption or
+# sender restart, either way self-healing within one beat).
+BEAT_REORDER_WINDOW = 64
+
 T_SYNC_REQUEST = 1
 T_SYNC_REPLY = 2
 T_INPUT = 3
@@ -76,6 +87,14 @@ T_MIGRATE_ACCEPT = 19
 T_MIGRATE_CHUNK = 20
 T_MIGRATE_DONE = 21
 T_FLEET_HEARTBEAT = 22
+# Reliable control-plane sublayer (transport/reliable.py): CtrlFrame wraps
+# one control datagram in a per-peer sequence number + CRC envelope; CtrlAck
+# acknowledges it. Retransmit-until-acked with receive-side dedup turns the
+# lossy UDP control wire into at-least-once + idempotent delivery for the
+# migration family under chaos. Same no-version-bump rule: a peer without
+# the sublayer drops the unknown type bytes unharmed.
+T_CTRL_FRAME = 23
+T_CTRL_ACK = 24
 
 # StateRequest.kind values.
 STATE_KIND_RING = 0  # world snapshot at one settled frame (desync resync)
@@ -290,23 +309,38 @@ class MigrateOffer:
     the chunk count about to follow; ``digest`` the 64-bit payload digest
     of the whole reassembled ServerCheckpointer-format blob (the target
     verifies it BEFORE unpacking — a corrupt migration must abort, not
-    readmit a plausible impostor)."""
+    readmit a plausible impostor). ``epoch`` is the match's fencing token:
+    the migration authority (balancer / ProcFleet parent) bumps it on every
+    transfer attempt, so a duplicated or delayed offer from a superseded
+    attempt is refused structurally instead of creating a second live copy
+    of the match (split-brain)."""
 
     nonce: int
     match_id: int
     frame: int
     total: int
     digest: int
+    epoch: int = 0
+
+
+# MigrateAccept.reason values when accept == 0.
+MIG_REFUSE_CAPACITY = 0  # no free slot / draining
+MIG_REFUSE_EPOCH = 1  # stale fencing token (superseded transfer attempt)
+MIG_REFUSE_DUP = 2  # match already hosted here (duplicate offer)
 
 
 @dataclasses.dataclass(frozen=True)
 class MigrateAccept:
     """Target -> source: ``accept`` 1 reserves capacity for the transfer
-    (0 = at capacity / refusing; the source readmits locally and nothing
-    is lost)."""
+    (0 = refusing; the source readmits locally and nothing is lost).
+    ``epoch`` echoes the offer's fencing token; ``reason`` types the
+    refusal (``MIG_REFUSE_*``) so the source can tell a capacity bounce
+    from an epoch-fence rejection."""
 
     nonce: int
     accept: int
+    epoch: int = 0
+    reason: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -314,7 +348,9 @@ class MigrateChunk:
     """One fragment of the snapshot blob (chunked like
     :class:`StateChunk`). ``frame`` repeats the offer's drain frame so a
     passive provenance tap can attribute the fragment to the match's
-    timeline; ``crc`` guards this fragment's bytes."""
+    timeline; ``crc`` guards this fragment's bytes; ``epoch`` carries the
+    offer's fencing token so a straggler chunk from a superseded attempt
+    can be fenced without consulting the nonce table."""
 
     nonce: int
     frame: int
@@ -322,17 +358,21 @@ class MigrateChunk:
     total: int
     crc: int
     payload: bytes
+    epoch: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
 class MigrateDone:
     """Target -> source: the match readmitted at ``frame`` (``ok`` 1) or
     the transfer failed digest/unpack (``ok`` 0 — the source readmits its
-    retained ticket; zero matches lost either way)."""
+    retained ticket; zero matches lost either way). ``epoch`` echoes the
+    offer's fencing token: the authority refuses a landing whose epoch is
+    older than the match's current one (the structural split-brain kill)."""
 
     nonce: int
     frame: int
     ok: int
+    epoch: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -341,7 +381,11 @@ class FleetHeartbeat:
     ``heartbeat_interval`` served frames. ``pages`` counts slots whose SLO
     burn level is "page" (the balancer's primary placement repellent);
     missed beats past the balancer's timeout mark the server dead and
-    trigger checkpoint failover."""
+    trigger checkpoint failover. ``beat_seq`` is a monotonic per-server
+    send counter: the receiver derives ``missed_beats`` from gaps in it
+    and refuses to let a REORDERED stale beat refresh liveness (a beat
+    with ``beat_seq`` <= the highest seen carries no new liveness
+    information)."""
 
     server_id: int
     frames_served: int
@@ -353,6 +397,30 @@ class FleetHeartbeat:
     # lifetime full-hit rate and waste ratio across the server's slots.
     spec_hit_permille: int = 0
     spec_waste_permille: int = 0
+    beat_seq: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class CtrlFrame:
+    """Reliable-sublayer envelope: one control datagram (``payload`` is a
+    fully-encoded inner frame, header included) under a per-peer ``seq``
+    and a CRC32 over the payload. The receiver acks every valid CtrlFrame
+    (including duplicates — the ack may have been the thing that was
+    lost), delivers each seq at most once, and drops CRC failures
+    silently (the sender retransmits)."""
+
+    seq: int
+    crc: int
+    payload: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class CtrlAck:
+    """Reliable-sublayer ack: ``seq`` received intact. Cumulative-free
+    (one ack per frame) — simplicity over bandwidth on a low-rate
+    control wire."""
+
+    seq: int
 
 
 Message = Union[
@@ -361,6 +429,7 @@ Message = Union[
     RelayHello, RelayWelcome, RelayForward, Subscribe,
     StreamDelta, StreamKeyframe, StreamAck,
     MigrateOffer, MigrateAccept, MigrateChunk, MigrateDone, FleetHeartbeat,
+    CtrlFrame, CtrlAck,
 ]
 
 _U32 = struct.Struct("<I")
@@ -377,13 +446,19 @@ _SUBSCRIBE = struct.Struct("<IiH")  # session_id, cursor, window
 _STREAM_DELTA = struct.Struct("<iiI")  # frame, base_frame, crc
 _STREAM_KF = struct.Struct("<iHHIQ")  # frame, seq, total, crc, digest
 _I32 = struct.Struct("<i")
-_MIG_OFFER = struct.Struct("<IIiHQ")  # nonce, match_id, frame, total, digest
-_MIG_ACCEPT = struct.Struct("<IB")  # nonce, accept
-_MIG_CHUNK = struct.Struct("<IiHHI")  # nonce, frame, seq, total, crc
-_MIG_DONE = struct.Struct("<IiB")  # nonce, frame, ok
+# Migration structs: the fencing ``epoch`` is APPENDED so every prefix
+# offset (and obs/provenance.py's prefix unpack_from reads) stays put.
+_MIG_OFFER = struct.Struct(
+    "<IIiHQI"
+)  # nonce, match_id, frame, total, digest, epoch
+_MIG_ACCEPT = struct.Struct("<IBIB")  # nonce, accept, epoch, reason
+_MIG_CHUNK = struct.Struct("<IiHHII")  # nonce, frame, seq, total, crc, epoch
+_MIG_DONE = struct.Struct("<IiBI")  # nonce, frame, ok, epoch
 _FLEET_HB = struct.Struct(
-    "<HIHHHHHH"
-)  # id, frames, active, free, quar, pages, spec_hit_pm, spec_waste_pm
+    "<HIHHHHHHI"
+)  # id, frames, active, free, quar, pages, spec_hit_pm, spec_waste_pm, beat_seq
+_CTRL_FRAME = struct.Struct("<II")  # seq, crc (payload follows)
+_CTRL_ACK = struct.Struct("<I")  # seq
 
 
 def encode(msg: Message) -> bytes:
@@ -473,10 +548,12 @@ def encode(msg: Message) -> bytes:
         return _HDR.pack(MAGIC, VERSION, T_MIGRATE_OFFER) + _MIG_OFFER.pack(
             msg.nonce & 0xFFFFFFFF, msg.match_id & 0xFFFFFFFF, msg.frame,
             msg.total & 0xFFFF, msg.digest & 0xFFFFFFFFFFFFFFFF,
+            msg.epoch & 0xFFFFFFFF,
         )
     if isinstance(msg, MigrateAccept):
         return _HDR.pack(MAGIC, VERSION, T_MIGRATE_ACCEPT) + _MIG_ACCEPT.pack(
-            msg.nonce & 0xFFFFFFFF, msg.accept & 0xFF
+            msg.nonce & 0xFFFFFFFF, msg.accept & 0xFF,
+            msg.epoch & 0xFFFFFFFF, msg.reason & 0xFF,
         )
     if isinstance(msg, MigrateChunk):
         return (
@@ -484,12 +561,14 @@ def encode(msg: Message) -> bytes:
             + _MIG_CHUNK.pack(
                 msg.nonce & 0xFFFFFFFF, msg.frame, msg.seq & 0xFFFF,
                 msg.total & 0xFFFF, msg.crc & 0xFFFFFFFF,
+                msg.epoch & 0xFFFFFFFF,
             )
             + msg.payload
         )
     if isinstance(msg, MigrateDone):
         return _HDR.pack(MAGIC, VERSION, T_MIGRATE_DONE) + _MIG_DONE.pack(
-            msg.nonce & 0xFFFFFFFF, msg.frame, msg.ok & 0xFF
+            msg.nonce & 0xFFFFFFFF, msg.frame, msg.ok & 0xFF,
+            msg.epoch & 0xFFFFFFFF,
         )
     if isinstance(msg, FleetHeartbeat):
         return _HDR.pack(MAGIC, VERSION, T_FLEET_HEARTBEAT) + _FLEET_HB.pack(
@@ -497,6 +576,17 @@ def encode(msg: Message) -> bytes:
             msg.slots_active & 0xFFFF, msg.slots_free & 0xFFFF,
             msg.quarantined & 0xFFFF, msg.pages & 0xFFFF,
             msg.spec_hit_permille & 0xFFFF, msg.spec_waste_permille & 0xFFFF,
+            msg.beat_seq & 0xFFFFFFFF,
+        )
+    if isinstance(msg, CtrlFrame):
+        return (
+            _HDR.pack(MAGIC, VERSION, T_CTRL_FRAME)
+            + _CTRL_FRAME.pack(msg.seq & 0xFFFFFFFF, msg.crc & 0xFFFFFFFF)
+            + msg.payload
+        )
+    if isinstance(msg, CtrlAck):
+        return _HDR.pack(MAGIC, VERSION, T_CTRL_ACK) + _CTRL_ACK.pack(
+            msg.seq & 0xFFFFFFFF
         )
     raise TypeError(f"unknown message {msg!r}")
 
@@ -578,26 +668,35 @@ def decode(data: bytes) -> Optional[Message]:
         if mtype == T_STREAM_ACK:
             return StreamAck(_I32.unpack_from(body)[0])
         if mtype == T_MIGRATE_OFFER:
-            nonce, mid, frame, total, digest = _MIG_OFFER.unpack_from(body)
-            return MigrateOffer(nonce, mid, frame, total, digest)
+            nonce, mid, frame, total, digest, epoch = _MIG_OFFER.unpack_from(
+                body
+            )
+            return MigrateOffer(nonce, mid, frame, total, digest, epoch)
         if mtype == T_MIGRATE_ACCEPT:
-            nonce, accept = _MIG_ACCEPT.unpack_from(body)
-            return MigrateAccept(nonce, accept)
+            nonce, accept, epoch, reason = _MIG_ACCEPT.unpack_from(body)
+            return MigrateAccept(nonce, accept, epoch, reason)
         if mtype == T_MIGRATE_CHUNK:
-            nonce, frame, seq, total, crc = _MIG_CHUNK.unpack_from(body)
+            nonce, frame, seq, total, crc, epoch = _MIG_CHUNK.unpack_from(body)
             return MigrateChunk(
-                nonce, frame, seq, total, crc, body[_MIG_CHUNK.size :]
+                nonce, frame, seq, total, crc, body[_MIG_CHUNK.size :], epoch
             )
         if mtype == T_MIGRATE_DONE:
-            nonce, frame, ok = _MIG_DONE.unpack_from(body)
-            return MigrateDone(nonce, frame, ok)
+            nonce, frame, ok, epoch = _MIG_DONE.unpack_from(body)
+            return MigrateDone(nonce, frame, ok, epoch)
         if mtype == T_FLEET_HEARTBEAT:
             (
-                sid, frames, active, free, quar, pages, hit_pm, waste_pm
+                sid, frames, active, free, quar, pages, hit_pm, waste_pm,
+                beat_seq,
             ) = _FLEET_HB.unpack_from(body)
             return FleetHeartbeat(
-                sid, frames, active, free, quar, pages, hit_pm, waste_pm
+                sid, frames, active, free, quar, pages, hit_pm, waste_pm,
+                beat_seq,
             )
+        if mtype == T_CTRL_FRAME:
+            seq, crc = _CTRL_FRAME.unpack_from(body)
+            return CtrlFrame(seq, crc, body[_CTRL_FRAME.size :])
+        if mtype == T_CTRL_ACK:
+            return CtrlAck(_CTRL_ACK.unpack_from(body)[0])
         return None
     except struct.error:
         return None

@@ -7,6 +7,9 @@ implementation (`/root/reference/examples/box_game/box_game_p2p.rs:57`
 wrong shape for ICI (survey §2.4). Implementations:
 
 - :class:`UdpSocket` — real UDP, non-blocking, for actual multi-host play.
+- :class:`ReliableSocket` — ack-driven retransmit + idempotent dedup for
+  the fleet control plane's migration frames (types 18-21), selective by
+  type byte so heartbeats stay fire-and-forget.
 - :class:`LoopbackNetwork` / :class:`LoopbackSocket` — deterministic
   in-memory transport with virtual time, configurable latency, jitter, and
   seeded packet loss: the injection seam the reference lacks (survey §4
@@ -18,3 +21,4 @@ wrong shape for ICI (survey §2.4). Implementations:
 from bevy_ggrs_tpu.transport.socket import NonBlockingSocket
 from bevy_ggrs_tpu.transport.loopback import LoopbackNetwork, LoopbackSocket
 from bevy_ggrs_tpu.transport.udp import UdpSocket
+from bevy_ggrs_tpu.transport.reliable import ReliableSocket

@@ -196,6 +196,21 @@ def check_row(row: dict, base: Optional[dict],
                 out.update(status="FAIL",
                            detail=f"autoscale row lost its {col} column")
                 return out
+        # Control-plane hardening columns: the chaos row proves the
+        # reliable wire + fencing story, and the calm row carries the
+        # same columns (all zeros) so a silently-dead counter is visible.
+        for col in ("ctrl_retransmits", "migrations_aborted_chaos",
+                    "epoch_fence_refusals", "degraded_beats"):
+            if not isinstance(row.get(col), (int, float)):
+                out.update(status="FAIL",
+                           detail=f"autoscale row lost its {col} column")
+                return out
+        if metric.endswith("_chaos") and row.get("failovers") != 0:
+            out.update(status="FAIL",
+                       detail="chaotic arc declared a live-but-partitioned "
+                              f"child dead ({row.get('failovers')!r} "
+                              "failovers; gate: 0)")
+            return out
     if metric.startswith("front_door_"):
         # The saturation-ladder row IS its health gates: a knee measured
         # with slot faults, compiles during admission churn, or a lost
