@@ -2279,6 +2279,237 @@ def _serve_chaos_case(S: int) -> dict:
         shutil.rmtree(ckpt_dir, ignore_errors=True)
 
 
+# Data-plane integrity tier (integrity.py, docs/serving.md
+# "Self-healing"): the SDC lifecycle under load. Headline value is the
+# non-sweep batched tick p50 with attestation enabled; the integrity
+# columns are the injected/detected/repaired-bitwise ledger, the
+# repair-resimulation span p99, and the wire segment's crc drop count —
+# gated hard in tools/bench_gate.py (every injection detected, every
+# repair bitwise, zero desyncs, zero lost matches, zero churn
+# recompiles).
+_SERVE_SDC_CONFIGS = {"serve_sdc_S64": 64}
+
+
+def _serve_sdc_case(S: int) -> dict:
+    from bevy_ggrs_tpu import integrity
+    from bevy_ggrs_tpu.chaos import ChaosPlan, ChaosSocket, Corrupt
+    from bevy_ggrs_tpu.models import box_game
+    from bevy_ggrs_tpu.runner import RollbackRunner
+    from bevy_ggrs_tpu.serve import MatchServer, SlotHealth
+    from bevy_ggrs_tpu.session import (
+        PlayerType, PredictionThreshold, SessionBuilder, SessionState,
+    )
+    from bevy_ggrs_tpu.transport.loopback import LoopbackNetwork
+    from bevy_ggrs_tpu.utils import xla_cache
+    from bevy_ggrs_tpu.utils.metrics import Metrics
+
+    P, MAXPRED, B, F = 2, 4, 8, 3
+    ATTEST = 4
+    ticks = int(os.environ.get("GGRS_SERVE_TICKS", "240") or "240")
+    ticks = max(ticks, 240)
+    inject_target = 8
+    rtt0 = _host_device_rtt_ms()
+    xla_cache.install_compile_listeners()
+    sdc_rng = np.random.RandomState(0x5DC)
+
+    def make_synctest():
+        return (
+            SessionBuilder(box_game.INPUT_SPEC)
+            .with_num_players(P)
+            .with_max_prediction_window(MAXPRED)
+            .with_check_distance(2)
+            .start_synctest_session()
+        )
+
+    def inputs_for(seed):
+        def f(frame, handle):
+            return np.uint8((frame * 3 + handle * 5 + seed) % 16)
+
+        return f
+
+    clk = [0.0]
+    metrics = Metrics()
+    server = MatchServer(
+        box_game.make_schedule(), box_game.make_world(P).commit(),
+        MAXPRED, P, box_game.INPUT_SPEC,
+        num_branches=B, spec_frames=F, capacity=S, stagger_groups=4,
+        metrics=metrics, clock=lambda: clk[0],
+        attest_interval=ATTEST,
+    )
+    server.warmup()
+    handle_of = {m: server.add_match(make_synctest(), inputs_for(m))
+                 for m in range(S)}
+
+    def inject(m):
+        """Flip one checksum-covered bit in match m's ring row holding
+        frame-3 — below the synctest reload depth (check_distance=2), so
+        the corruption is never loaded before the sweep sees it, and deep
+        enough that the row survives until this tick's sweep (depth
+        MAXPRED+1 = 5: the row is overwritten two ticks later)."""
+        h = handle_of[m]
+        if h in server._lanes:
+            return False
+        core = server.groups[h.group]
+        s = core.slots[h.slot]
+        if not s.active or s.frame < 3:
+            return False
+        frames_h = np.asarray(core.rings.frames)[h.slot]
+        rows = np.flatnonzero(frames_h == s.frame - 3)
+        if rows.size == 0:
+            return False
+        core.rings, _ = integrity.flip_ring_bit(
+            core.rings, int(rows[0]), sdc_rng, slot=h.slot
+        )
+        return True
+
+    sdc_injected = 0
+    compiles_seg = None
+    tick_ms = []  # (ms, sweep_tick)
+    for t in range(ticks):
+        if t == 16:
+            # Admission/warm churn is over; everything past here —
+            # including every injection and repair — must be
+            # recompile-free.
+            compiles_seg = xla_cache.compile_counters()["backend_compiles"]
+        # Inject only on sweep-aligned ticks (the sweep runs inside this
+        # same run_frame, after the dispatch): detection latency is the
+        # cadence, never an overwrite race.
+        if (
+            t >= 40 and sdc_injected < inject_target
+            and server.frames_served % ATTEST == 0
+        ):
+            if inject((sdc_injected * 11) % S):
+                sdc_injected += 1
+        t0 = time.perf_counter()
+        server.run_frame()
+        for core in server.groups:
+            jax.block_until_ready(core.states)
+        tick_ms.append(((time.perf_counter() - t0) * 1000.0,
+                        server.frames_served % ATTEST == 0))
+        clk[0] += 1.0 / 60.0
+    churn_recompiles = (
+        xla_cache.compile_counters()["backend_compiles"] - compiles_seg
+    )
+    all_healthy = all(
+        server.health_of(h) is SlotHealth.HEALTHY for h in server._matches
+    )
+    repair_frames = [
+        float(v) for v in metrics.series.get("sdc_repair_frames", ())
+    ]
+
+    # Wire segment: a real 2-peer P2P match under an aggressive Corrupt
+    # window (protocol v5 crc trailer) — corrupt datagrams must be
+    # dropped-and-counted, never decoded, so the pair converges with zero
+    # desyncs; redundant input spans re-deliver what the drops cost.
+    net = LoopbackNetwork()
+    plan = ChaosPlan(0x5DC, (Corrupt(0.3, 4.0, 0.10),))
+    wire_metrics = Metrics()
+    peers = []
+    for me in range(2):
+        sock = ChaosSocket(
+            net.socket(("peer", me)), plan,
+            clock=lambda: net.now, addr=("peer", me),
+        )
+        builder = (
+            SessionBuilder(box_game.INPUT_SPEC)
+            .with_num_players(P)
+            .with_max_prediction_window(MAXPRED)
+        )
+        for h in range(P):
+            builder.add_player(
+                PlayerType.local() if h == me
+                else PlayerType.remote(("peer", h)), h,
+            )
+        session = builder.start_p2p_session(sock, clock=lambda: net.now)
+        runner = RollbackRunner(
+            box_game.make_schedule(), box_game.make_world(P).commit(),
+            max_prediction=MAXPRED, num_players=P,
+            input_spec=box_game.INPUT_SPEC,
+            metrics=wire_metrics if me == 0 else None,
+        )
+        runner.warmup()
+        peers.append((session, runner))
+    desyncs = 0
+    for _ in range(400):
+        net.advance(1.0 / 60.0)
+        for session, runner in peers:
+            flush = getattr(runner, "flush_reports", None)
+            if flush is not None:
+                flush(session)
+            session.poll_remote_clients()
+            for ev in session.events():
+                if ev.kind.name == "DESYNC_DETECTED":
+                    desyncs += 1
+            if session.current_state() != SessionState.RUNNING:
+                continue
+            for h in session.local_player_handles():
+                session.add_local_input(
+                    h, np.uint8((session.current_frame // 3 + h) % 4)
+                )
+            try:
+                runner.handle_requests(session.advance_frame(), session)
+            except PredictionThreshold:
+                continue
+    data_crc_drops = sum(
+        ep.data_crc_drops
+        for session, _ in peers
+        for ep in session._endpoints.values()
+    )
+    corrupted_sends = sum(
+        1 for session, _ in peers
+        for _, kind, _ in session.socket.faults if kind == "corrupt"
+    )
+
+    healthy = [ms for ms, sweep in tick_ms[16:] if not sweep]
+    sweeps = [ms for ms, sweep in tick_ms[16:] if sweep]
+    healthy_p50 = float(np.percentile(healthy, 50))
+    return _entry(
+        f"serve_sdc_S{S}",
+        healthy_p50, S, B,
+        rtt_ms=rtt0,
+        sessions=S,
+        model="box_game",
+        ticks=len(tick_ms),
+        tick_p50_healthy_ms=round(healthy_p50, 4),
+        tick_p50_sweep_ms=round(float(np.percentile(sweeps, 50)), 4),
+        attest_interval=ATTEST,
+        sdc_injected=int(sdc_injected),
+        sdc_detected=int(metrics.counters.get("sdc_detected", 0)),
+        sdc_repaired=int(metrics.counters.get("sdc_repaired", 0)),
+        sdc_repaired_bitwise=int(
+            metrics.counters.get("sdc_repaired_bitwise", 0)
+        ),
+        sdc_unrepairable=int(metrics.counters.get("sdc_unrepairable", 0)),
+        repair_frames_p50=(
+            round(float(np.percentile(repair_frames, 50)), 2)
+            if repair_frames else None
+        ),
+        repair_frames_p99=(
+            round(float(np.percentile(repair_frames, 99)), 2)
+            if repair_frames else None
+        ),
+        data_crc_drops=int(data_crc_drops),
+        corrupted_sends=int(corrupted_sends),
+        desyncs=int(
+            desyncs + wire_metrics.counters.get("desyncs_detected", 0)
+        ),
+        matches_lost=int(server.evictions_total),
+        all_slots_healthy=bool(all_healthy),
+        churn_recompiles=int(churn_recompiles),
+        notes=(
+            f"{sdc_injected} single-bit ring flips injected sweep-aligned "
+            f"into {S} batched synctest matches (attest_interval "
+            f"{ATTEST}): every one must be detected by the digest sweep "
+            "and self-healed bitwise in place, quarantine-free and "
+            "recompile-free; repair_frames is the resimulation span from "
+            "the deepest clean snapshot. The wire segment runs a real "
+            "2-peer P2P match under Corrupt(10%) for 400 frames: flipped "
+            "datagrams are dropped-and-counted by the v5 crc trailer "
+            "(data_crc_drops), never decoded — gated on zero desyncs"
+        ),
+    )
+
+
 # Fleet tier (fleet/, docs/serving.md): S matches split across TWO
 # supervised MatchServers under a FleetBalancer. Headline value is the
 # healthy fleet-tick p50; the robustness columns are live-migration
@@ -3175,6 +3406,8 @@ def run_config(name: str) -> dict:
         return _serve_batched_case(model, S)
     if name in _SERVE_CHAOS_CONFIGS:
         return _serve_chaos_case(_SERVE_CHAOS_CONFIGS[name])
+    if name in _SERVE_SDC_CONFIGS:
+        return _serve_sdc_case(_SERVE_SDC_CONFIGS[name])
     if name in _FLEET_CONFIGS:
         return _fleet_migrate_case(_FLEET_CONFIGS[name])
     if name in _FRONT_DOOR_CONFIGS:
@@ -3206,6 +3439,7 @@ def run_matrix() -> list:
                  + list(_LIVE_CONFIGS) + list(_EIGHTP_CONFIGS)
                  + list(_MULTIHOST_CONFIGS) + list(_RELAY_CONFIGS)
                  + list(_SERVE_CONFIGS) + list(_SERVE_CHAOS_CONFIGS)
+                 + list(_SERVE_SDC_CONFIGS)
                  + list(_FLEET_CONFIGS) + list(_FRONT_DOOR_CONFIGS)
                  + list(_AUTOSCALE_CONFIGS)):
         proc = subprocess.run(
@@ -3295,6 +3529,7 @@ def main() -> None:
                  + list(_LIVE_CONFIGS) + list(_EIGHTP_CONFIGS)
                  + list(_MULTIHOST_CONFIGS) + list(_RELAY_CONFIGS)
                  + list(_SERVE_CONFIGS) + list(_SERVE_CHAOS_CONFIGS)
+                 + list(_SERVE_SDC_CONFIGS)
                  + list(_FLEET_CONFIGS) + list(_FRONT_DOOR_CONFIGS)
                  + list(_AUTOSCALE_CONFIGS))
         if idx >= len(args) or args[idx] not in valid:

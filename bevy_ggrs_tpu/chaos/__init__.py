@@ -13,6 +13,7 @@ reproducible from the plan's seed (docs/chaos.md).
 from bevy_ggrs_tpu.chaos.plan import (
     BalancerPartition,
     ChaosPlan,
+    CheckpointCorrupt,
     Corrupt,
     Duplicate,
     KillRestart,
@@ -25,6 +26,7 @@ from bevy_ggrs_tpu.chaos.plan import (
     ServerKillRestart,
     ServerLoss,
     ServerSpawn,
+    SnapshotCorrupt,
 )
 from bevy_ggrs_tpu.chaos.socket import ChaosSocket
 
@@ -32,6 +34,7 @@ __all__ = [
     "BalancerPartition",
     "ChaosPlan",
     "ChaosSocket",
+    "CheckpointCorrupt",
     "Corrupt",
     "Duplicate",
     "KillRestart",
@@ -44,4 +47,5 @@ __all__ = [
     "ServerKillRestart",
     "ServerLoss",
     "ServerSpawn",
+    "SnapshotCorrupt",
 ]

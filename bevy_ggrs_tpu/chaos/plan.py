@@ -192,10 +192,39 @@ class ServerDrain:
     server: object
 
 
+@dataclasses.dataclass(frozen=True)
+class SnapshotCorrupt:
+    """Script a SILENT in-memory corruption (the StateFault family): at
+    ``at`` the harness flips one checksum-covered bit inside a live
+    snapshot-ring row of ``target`` (a peer address, or a serve-tier slot
+    — harness-interpreted, like the kill family's identities) via
+    :func:`bevy_ggrs_tpu.integrity.flip_ring_bit`. The socket layer
+    ignores it. The attestation sweep must DETECT the flip within its
+    interval and repair it bitwise by rollback resimulation — zero
+    desyncs, zero lost matches, no quarantine."""
+
+    at: float
+    target: object = None
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointCorrupt:
+    """Flip one random bit in the newest on-disk checkpoint file owned by
+    ``target`` at ``at`` (:func:`bevy_ggrs_tpu.integrity.flip_file_bit`).
+    The digest-guarded loaders must refuse the file with a typed
+    ``ValueError`` — never restore a plausible impostor — and
+    ``ServerCheckpointer.restore`` must fall back to the next-oldest
+    retained checkpoint. Harness-level execution, replayable from the
+    plan like the rest of the StateFault family."""
+
+    at: float
+    target: object = None
+
+
 Directive = Union[
     LossBurst, Reorder, Duplicate, Corrupt, Partition, KillRestart,
     RelayKillRestart, ServerKillRestart, BalancerPartition, MigrateMatch,
-    ServerLoss, ServerSpawn, ServerDrain,
+    ServerLoss, ServerSpawn, ServerDrain, SnapshotCorrupt, CheckpointCorrupt,
 ]
 
 _KINDS = {
@@ -212,6 +241,8 @@ _KINDS = {
     "server_loss": ServerLoss,
     "server_spawn": ServerSpawn,
     "server_drain": ServerDrain,
+    "snapshot_corrupt": SnapshotCorrupt,
+    "checkpoint_corrupt": CheckpointCorrupt,
 }
 _NAMES = {cls: name for name, cls in _KINDS.items()}
 
@@ -304,6 +335,18 @@ class ChaosPlan:
             key=lambda d: d.at,
         )
 
+    def snapshot_corrupts(self) -> List[SnapshotCorrupt]:
+        return sorted(
+            (d for d in self.directives if isinstance(d, SnapshotCorrupt)),
+            key=lambda d: d.at,
+        )
+
+    def checkpoint_corrupts(self) -> List[CheckpointCorrupt]:
+        return sorted(
+            (d for d in self.directives if isinstance(d, CheckpointCorrupt)),
+            key=lambda d: d.at,
+        )
+
     def horizon(self) -> float:
         """Time at which the last directive has expired/healed."""
         t = 0.0
@@ -313,7 +356,11 @@ class ChaosPlan:
             ):
                 t = max(t, d.at + d.down_for)
             elif isinstance(
-                d, (MigrateMatch, ServerLoss, ServerSpawn, ServerDrain)
+                d,
+                (
+                    MigrateMatch, ServerLoss, ServerSpawn, ServerDrain,
+                    SnapshotCorrupt, CheckpointCorrupt,
+                ),
             ):
                 t = max(t, d.at)
             else:
@@ -329,7 +376,7 @@ class ChaosPlan:
             for f in dataclasses.fields(d):
                 v = getattr(d, f.name)
                 entry[f.name] = _addr_to_json(v) if f.name in (
-                    "src", "dst", "peer", "relay", "server"
+                    "src", "dst", "peer", "relay", "server", "target"
                 ) else v
             out.append(entry)
         return json.dumps({"seed": self.seed, "directives": out}, indent=2)
@@ -341,7 +388,7 @@ class ChaosPlan:
         for entry in raw["directives"]:
             entry = dict(entry)
             kind = _KINDS[entry.pop("kind")]
-            for k in ("src", "dst", "peer", "relay", "server"):
+            for k in ("src", "dst", "peer", "relay", "server", "target"):
                 if k in entry:
                     entry[k] = _addr_from_json(entry[k])
             directives.append(kind(**entry))
@@ -362,6 +409,7 @@ class ChaosPlan:
         fleet_matches: int = 0,
         elastic: bool = False,
         control: bool = False,
+        sdc: bool = False,
     ) -> "ChaosPlan":
         """A deterministic mixed-fault schedule over ``duration`` seconds:
         a few loss bursts, one reorder window, one duplication window, one
@@ -387,7 +435,13 @@ class ChaosPlan:
         the server-id identity fleet ChaosSockets carry) — aimed at the
         type 18–21 migration wire and the type-22 heartbeat stream. Same
         ``(seed, duration, peers, relay, match_server, fleet,
-        fleet_matches, elastic, control)`` -> same plan, always."""
+        fleet_matches, elastic, control)`` -> same plan, always. With
+        ``sdc=True`` the StateFault family is appended LAST of all (after
+        the control draws, preserving byte-identity of every pre-sdc
+        schedule): two :class:`SnapshotCorrupt` silent bit flips targeting
+        peers (or fleet members when no peers are named), and — when a
+        ``match_server`` or ``fleet`` exists to own checkpoint files — one
+        :class:`CheckpointCorrupt` late in the run."""
         rng = np.random.RandomState(seed & 0x7FFFFFFF)
         span = max(float(duration), 1.0)
         d: List[Directive] = []
@@ -474,4 +528,27 @@ class ChaosPlan:
             d.append(Partition(
                 t0, t0 + float(rng.uniform(0.03, 0.07) * span),
                 src=victim))
+        if sdc:
+            # StateFault family — drawn LAST of all (after the control
+            # draws), so every pre-sdc plan a seed ever produced stays
+            # byte-identical. Targets prefer peers (P2P soaks); fleets
+            # fall back to member ids; a bare serve soak gets None and the
+            # harness picks its own victim slot.
+            domain = peers if peers else fleet
+            for _ in range(2):
+                tgt = (
+                    domain[int(rng.randint(0, len(domain)))]
+                    if domain else None
+                )
+                t0 = float(rng.uniform(0.2 * span, 0.7 * span))
+                d.append(SnapshotCorrupt(t0, tgt))
+            if match_server is not None or fleet:
+                tgt = (
+                    match_server if match_server is not None
+                    else fleet[int(rng.randint(0, len(fleet)))]
+                )
+                # Late: the rolling keep-window must already hold >1 file
+                # so the restore fallback has somewhere to land.
+                t0 = float(rng.uniform(0.6 * span, 0.85 * span))
+                d.append(CheckpointCorrupt(t0, tgt))
         return cls(seed, tuple(d))

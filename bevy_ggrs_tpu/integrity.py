@@ -1,0 +1,262 @@
+"""Silent-data-corruption (SDC) integrity: attestation, forensics, chaos.
+
+HBM-resident match state lives for hours on the north-star fleet, which is
+long enough for silent corruption (bit flips that no exception reports —
+cf. "SDC at scale" / "Cores that don't count") to be a real fault class
+rather than a hypothetical. This module makes it *detectable* and
+*attributable*:
+
+- **Attestation** (:func:`attest_ring`): recompute every occupied
+  SnapshotRing row's two-lane digest and compare against the digest
+  ``ring_save`` stored at save time. The recompute is one jitted vmapped
+  pass over the ``[depth]`` row axis (``[S, depth]`` for serve-tier stacked
+  rings — one more vmap level, amortized over the whole batch exactly like
+  the checksum stream). A mismatch means the row's bytes changed *after*
+  they were saved: silent in-memory corruption, caught within one
+  attestation interval instead of surfacing frames later as an
+  unexplainable cross-peer checksum mismatch.
+- **Repair** is rollback's job, not this module's: the runner / batched
+  core restore the deepest clean (digest-verified) snapshot and
+  resimulate from the confirmed input log (see
+  ``RollbackRunner.attest_and_repair`` and
+  ``BatchedSessionCore.repair_slot``). Determinism makes the recomputed
+  rows bitwise equal to the originals, so a landed repair needs no
+  quarantine. This module only supplies the detection mask, the typed
+  fault, and the forensics.
+- **Forensics** (:func:`host_row` / :func:`first_corrupt_field`): name the
+  first registered field whose bytes differ between the corrupt row and
+  its repaired replacement — pure NumPy on host copies, so the fault path
+  never compiles anything (the churn_recompiles == 0 contract covers
+  repair too).
+- **Chaos injection** (:func:`flip_ring_bit` / :func:`flip_file_bit`): the
+  StateFault directive family's hands. Ring flips land only in words the
+  checksum covers (a flip in a masked non-present word would be both
+  undetectable and semantically inert — injecting it would prove nothing).
+
+Scope note: attestation covers ring rows and digest-guarded checkpoint /
+transfer payloads — the places a reference digest exists. The *live*
+working state has no stored reference (it changes every frame), but it is
+covered transitively: every save recomputes its digest, and the cross-peer
+checksum exchange compares confirmed frames end to end.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bevy_ggrs_tpu.state import SnapshotRing, active_checksum
+
+
+class StateFault(RuntimeError):
+    """Typed SDC fault: corruption was detected and could NOT be repaired
+    locally (no clean snapshot below the corrupt rows, or the confirmed
+    input log no longer covers the resimulation span). Carriers escalate:
+    ring repair -> supervisor type-9/10 donor transfer -> fleet checkpoint
+    (docs/serving.md's self-healing ladder)."""
+
+    def __init__(self, reason: str, frames=(), slot: Optional[int] = None,
+                 detail: str = ""):
+        self.reason = str(reason)
+        self.frames = tuple(int(f) for f in frames)
+        self.slot = slot
+        self.detail = detail
+        at = f" slot={slot}" if slot is not None else ""
+        why = f" — {detail}" if detail else ""
+        super().__init__(
+            f"StateFault({self.reason}){at}: frames={list(self.frames)}{why}"
+        )
+
+
+# Jitted digest passes. jax.jit caches per input pytree structure, so one
+# function serves every model family — but each structure's first call
+# compiles, which is why runner/core warmup routes through :func:`warm`
+# (attestation must never compile on the serving path).
+@jax.jit
+def _digests_rows(states):
+    """Per-row digests of a singleton ring's states (leaves [depth, ...])."""
+    return jax.vmap(active_checksum)(states)
+
+
+@jax.jit
+def _digests_slots_rows(states):
+    """Per-row digests of a serve-tier stacked ring ([S, depth, ...])."""
+    return jax.vmap(jax.vmap(active_checksum))(states)
+
+
+@jax.jit
+def _row_digest(states, row):
+    """Digest of ONE singleton ring row (``row`` traced — one compile
+    covers every row index). The restore-path guard's workhorse."""
+    pick = jax.tree_util.tree_map(
+        lambda x: jax.lax.dynamic_index_in_dim(x, row, 0, keepdims=False),
+        states,
+    )
+    return active_checksum(pick)
+
+
+@jax.jit
+def _state_digest(state):
+    """Digest of one live world state (the bitwise-repair witness)."""
+    return active_checksum(state)
+
+
+@jax.jit
+def _states_digests(states):
+    """Digests of the serve tier's stacked live states ([S, ...])."""
+    return jax.vmap(active_checksum)(states)
+
+
+def ring_digests(ring: SnapshotRing) -> jnp.ndarray:
+    """Recomputed per-row digests, shaped like ``ring.checksums``."""
+    fn = _digests_rows if ring.frames.ndim == 1 else _digests_slots_rows
+    return fn(ring.states)
+
+
+def attest_ring(ring: SnapshotRing) -> np.ndarray:
+    """Attestation mask shaped like ``ring.frames``: True where an occupied
+    row's recomputed digest disagrees with the digest stored at save time
+    (corruption in the states OR in the stored digest lane — either way
+    the row can no longer be trusted as a rollback base)."""
+    digests = np.asarray(ring_digests(ring))
+    frames = np.asarray(ring.frames)
+    stored = np.asarray(ring.checksums)
+    return (frames >= 0) & np.any(digests != stored, axis=-1)
+
+
+def verify_row(ring: SnapshotRing, frame: int) -> bool:
+    """Restore-path guard (singleton rings): does ``frame``'s row still
+    hash to its save-time digest? A non-resident frame returns True — a
+    load targeting a rotated-out frame is a protocol bug, not SDC, and the
+    executor's existing semantics own it."""
+    row = int(frame) % ring.depth
+    frames = np.asarray(ring.frames)
+    if int(frames[row]) != int(frame):
+        return True
+    digest = np.asarray(_row_digest(ring.states, row))
+    stored = np.asarray(ring.checksums)[row]
+    return bool((digest == stored).all())
+
+
+def warm(ring: SnapshotRing, state=None, states=None) -> None:
+    """Compile every digest pass this ring/state structure will need, so
+    attestation and repair stay recompile-free after warmup."""
+    ring_digests(ring)
+    if ring.frames.ndim == 1:
+        _row_digest(ring.states, 0)
+    if state is not None:
+        _state_digest(state)
+    if states is not None:
+        _states_digests(states)
+
+
+# ---------------------------------------------------------------------------
+# Forensics: name the first corrupt field
+# ---------------------------------------------------------------------------
+
+
+def host_row(ring: SnapshotRing, row: int, slot: Optional[int] = None):
+    """Host copy of one ring row's registered fields, keyed in canonical
+    order (rollback_id, alive, then present/component pairs, then resource
+    leaves). Whole-leaf device->host transfers only — no device ops, so
+    the fault path triggers zero compiles."""
+    idx = (row,) if slot is None else (slot, row)
+    st = ring.states
+    out = {
+        "rollback_id": np.array(st.rollback_id)[idx],
+        "alive": np.array(st.alive)[idx],
+    }
+    for name in sorted(st.components):
+        out[f"present/{name}"] = np.array(st.present[name])[idx]
+        out[f"component/{name}"] = np.array(st.components[name])[idx]
+    for name in sorted(st.resources):
+        leaves = jax.tree_util.tree_leaves(st.resources[name])
+        for j, leaf in enumerate(leaves):
+            out[f"resource/{name}/{j}"] = np.array(leaf)[idx]
+    return out
+
+
+def first_corrupt_field(before: dict, after: dict) -> Optional[str]:
+    """First field (canonical :func:`host_row` order) whose bytes differ
+    between the corrupt row and its repaired replacement — the name the
+    forensics dump and the StateFault event carry."""
+    for name, arr in before.items():
+        if not np.array_equal(arr, after.get(name)):
+            return name
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Chaos injection (StateFault directive family)
+# ---------------------------------------------------------------------------
+
+
+def flip_ring_bit(ring: SnapshotRing, row: int, rng,
+                  slot: Optional[int] = None):
+    """Flip one random bit inside ring row ``row`` (batch slot ``slot``
+    for stacked serve rings), restricted to words the checksum covers so
+    the injection is *guaranteed detectable*: a non-bool component of a
+    live+present entity, the rollback_id of a live entity, or (empty
+    world) an alive bit itself. Returns ``(ring, info)`` with the injected
+    field named for the soak's forensics cross-check."""
+    idx = (row,) if slot is None else (slot, row)
+    st = ring.states
+    alive = np.array(st.alive)[idx]
+    live = np.flatnonzero(alive)
+    comp_names = []
+    for name in sorted(st.components):
+        if st.components[name].dtype == jnp.bool_:
+            continue
+        pres = np.array(st.present[name])[idx]
+        if np.flatnonzero(pres & alive).size:
+            comp_names.append(name)
+    if live.size and comp_names and float(rng.random_sample()) < 0.5:
+        name = comp_names[int(rng.randint(0, len(comp_names)))]
+        pres = np.array(st.present[name])[idx]
+        slots_ = np.flatnonzero(pres & alive)
+        k = int(slots_[int(rng.randint(0, slots_.size))])
+        full = np.array(st.components[name])
+        row_bytes = full[idx].reshape(full[idx].shape[0], -1)[k].view(np.uint8)
+        b = int(rng.randint(0, row_bytes.size * 8))
+        row_bytes[b // 8] ^= np.uint8(1 << (b % 8))
+        new = st.replace(components={**st.components, name: jnp.asarray(full)})
+        info = {"field": f"component/{name}", "entity": k, "bit": b}
+    elif live.size:
+        k = int(live[int(rng.randint(0, live.size))])
+        full = np.array(st.rollback_id)
+        bit = int(rng.randint(0, 32))
+        full.view(np.uint32)[idx + (k,)] ^= np.uint32(1 << bit)
+        new = st.replace(rollback_id=jnp.asarray(full))
+        info = {"field": "rollback_id", "entity": k, "bit": bit}
+    else:
+        k = int(rng.randint(0, alive.shape[0]))
+        full = np.array(st.alive)
+        full[idx + (k,)] = ~full[idx + (k,)]
+        new = st.replace(alive=jnp.asarray(full))
+        info = {"field": "alive", "entity": k, "bit": 0}
+    if slot is not None:
+        info["slot"] = int(slot)
+    info["row"] = int(row)
+    return ring.replace(states=new), info
+
+
+def flip_file_bit(path: str, rng) -> Optional[dict]:
+    """Flip one random bit in a file on disk (checkpoint-corruption chaos).
+    The digest-guarded loaders must then raise a typed ValueError instead
+    of restoring a plausible impostor. Returns the injection record, or
+    None when the file is empty/absent."""
+    try:
+        with open(path, "rb") as f:
+            data = bytearray(f.read())
+    except OSError:
+        return None
+    if not data:
+        return None
+    b = int(rng.randint(0, len(data) * 8))
+    data[b // 8] ^= 1 << (b % 8)
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+    return {"path": str(path), "bit": b}

@@ -85,6 +85,22 @@ def desync_report(
         "breakdown_frame": breakdown_frame,
         "breakdown_source": breakdown_source,
     }
+    # Silent-corruption context (integrity.py): the runner's undrained
+    # StateFault records, each naming the first field whose lane digest
+    # disagreed — for a desync that was really an un-detected SDC, this
+    # points at the corrupt tensor directly.
+    recs = getattr(runner, "state_faults", None)
+    if recs:
+        dump["state_faults"] = [
+            {
+                "reason": r.get("reason"),
+                "frames": [int(f) for f in r.get("frames", ())],
+                "repaired": bool(r.get("repaired")),
+                "bitwise": r.get("bitwise"),
+                "first_corrupt_field": r.get("field"),
+            }
+            for r in recs
+        ]
     if chaos_plan is not None:
         dump["chaos_plan"] = chaos_plan.to_json()
     faults = getattr(session.socket, "faults", None)
@@ -98,11 +114,14 @@ def desync_report(
 
 
 class DesyncForensics:
-    """Watches the event stream and builds one dump per desynced frame.
+    """Watches the event stream and builds one dump per desynced frame —
+    and per silent-corruption incident (``STATE_FAULT``), whose dump
+    additionally names the first corrupt field.
 
     Feed every drained event batch to :meth:`scan` (promptness matters —
     see module docstring). With ``out_dir`` set, each dump is also written
-    as ``desync_f{frame}.json``, the artifact CI uploads."""
+    as ``desync_f{frame}.json`` (``sdc_f{frame}.json`` for corruption
+    incidents), the artifact CI uploads."""
 
     def __init__(
         self,
@@ -128,6 +147,9 @@ class DesyncForensics:
         for e in events:
             # Matched by name, not identity, so obs never imports the
             # session package (keeps the dependency one-directional).
+            if e.kind.name == "STATE_FAULT":
+                new.extend(self._scan_state_fault(e))
+                continue
             if e.kind.name != "DESYNC_DETECTED":
                 continue
             frame = e.data["frame"]
@@ -151,6 +173,41 @@ class DesyncForensics:
                 with open(os.path.join(self.out_dir, name), "w") as f:
                     json.dump(dump, f, indent=1)
         return new
+
+    def _scan_state_fault(self, e) -> List[dict]:
+        """One dump per silent-corruption incident (``STATE_FAULT``,
+        integrity.py): the same replayable artifact as a desync dump,
+        plus the ``sdc`` record whose ``first_corrupt_field`` names the
+        tensor the attestation sweep caught red-handed — the "what" a
+        checksum breakdown can no longer answer once the repair landed
+        bitwise."""
+        frames = [int(f) for f in (e.data.get("frames") or ())]
+        frame = frames[0] if frames else NULL_FRAME
+        key = ("sdc", frame)
+        if key in self._seen_frames:
+            return []
+        self._seen_frames.add(key)
+        dump = desync_report(
+            self.session,
+            runner=self.runner,
+            frame=frame,
+            recorder=self.recorder,
+            chaos_plan=self.chaos_plan,
+        )
+        dump["sdc"] = {
+            "reason": e.data.get("reason"),
+            "frames": frames,
+            "repaired": bool(e.data.get("repaired")),
+            "bitwise": e.data.get("bitwise"),
+            "first_corrupt_field": e.data.get("field"),
+        }
+        self.dumps.append(dump)
+        if self.out_dir is not None:
+            os.makedirs(self.out_dir, exist_ok=True)
+            name = f"sdc{self.tag}_f{frame}.json"
+            with open(os.path.join(self.out_dir, name), "w") as f:
+                json.dump(dump, f, indent=1)
+        return [dump]
 
     @staticmethod
     def compare(dump_a: dict, dump_b: dict) -> dict:

@@ -261,6 +261,78 @@ def _ledger_section(ledger) -> str:
     return "".join(parts)
 
 
+_SDC_COUNTERS = (
+    # (counter, meaning, css class when nonzero)
+    ("data_crc_drops", "corrupt datagrams dropped at the wire (v5 crc)", ""),
+    ("sdc_detected", "corrupt ring rows found by the attestation sweep", ""),
+    ("sdc_repaired", "slots self-healed in place by resimulation", ""),
+    ("sdc_repaired_bitwise", "repairs verified bitwise against the "
+     "expected digests", ""),
+    ("sdc_unrepairable", "slots with no clean snapshot left (escalated)",
+     "page"),
+    ("sdc_faults", "typed StateFault records drained by the supervisor", ""),
+    ("sdc_escalations", "faults escalated to the donor-transfer rung",
+     "warn"),
+)
+
+
+def _sdc_section(metrics) -> str:
+    """Data-plane integrity ledger (docs/serving.md "Self-healing"): the
+    detect -> repair -> verify accounting for silent corruption, plus the
+    repair-resimulation spans. Rendered only when the metrics object
+    carries any of the SDC counters; a repair count that trails the
+    detect count, or any non-bitwise repair, is flagged."""
+    counters = getattr(metrics, "counters", None)
+    series = getattr(metrics, "series", None)
+    if counters is None:
+        return ""
+    present = [
+        (name, meaning, bad_cls)
+        for name, meaning, bad_cls in _SDC_COUNTERS
+        if name in counters
+    ]
+    if not present:
+        return ""
+    rows = []
+    for name, meaning, bad_cls in present:
+        v = counters.get(name, 0)
+        cls = bad_cls if (bad_cls and v) else ""
+        rows.append([name, (v, cls), meaning])
+    detected = counters.get("sdc_detected", 0)
+    repaired = counters.get("sdc_repaired", 0)
+    bitwise = counters.get("sdc_repaired_bitwise", 0)
+    notes = []
+    if repaired < detected:
+        notes.append(
+            f"{int(detected - repaired)} detection(s) without an in-place "
+            "repair — check sdc_unrepairable / the eviction ladder"
+        )
+    if bitwise < repaired:
+        notes.append(
+            f"{int(repaired - bitwise)} repair(s) did NOT land bitwise — "
+            "the slot's timeline left the batch"
+        )
+    parts = ["<h2>Data integrity (SDC)</h2>",
+             _table(["counter", "count", "meaning"], rows, left=1)]
+    for n in notes:
+        parts.append(f"<p class='page'>{_esc(n)}</p>")
+    spans = list((series or {}).get("sdc_repair_frames", ()))
+    if spans:
+        spans.sort()
+        parts.append(
+            "<p class='small'>repair resimulation spans (frames): "
+            f"n={len(spans)} p50={_fmt(spans[len(spans) // 2])} "
+            f"max={_fmt(spans[-1])}</p>"
+        )
+    per_slot = sorted(
+        (k, v) for k, v in counters.items()
+        if k.startswith('sdc_detected{')
+    )
+    if per_slot:
+        parts.append(_table(["slot", "detections"], per_slot, left=1))
+    return "".join(parts)
+
+
 def _metrics_section(metrics) -> str:
     summ = metrics.summary() if hasattr(metrics, "summary") else dict(metrics)
     if not summ:
@@ -317,6 +389,10 @@ def build_report(
         sections.append(
             "<h2>Speculation ledger</h2>" + _ledger_section(ledger)
         )
+    if metrics is not None:
+        sdc = _sdc_section(metrics)
+        if sdc:
+            sections.append(sdc)
     if tracers:
         sections.append("<h2>Span summaries</h2>" + _spans_section(tracers))
     if recorders:

@@ -95,6 +95,21 @@ class RollbackRunner:
         self.report_checksums = report_checksums
         self.rollback_frames_total = 0  # observability: resimulated frames
         self.rollbacks_total = 0
+        # SDC integrity (bevy_ggrs_tpu.integrity): verify a rollback's
+        # target ring row against its save-time digest before resimulating
+        # from it — a corrupted row must raise/repair as a typed fault,
+        # never silently seed a resim from garbage.
+        self.verify_restores = True
+        # As-used (bits, status) per advanced frame, retained a little past
+        # ring depth: the confirmed input log the repair engine resimulates
+        # from. Always on — a handful of small host arrays per frame.
+        self._used_inputs: dict = {}
+        # Detection reports (appended by attest_and_repair / the restore
+        # guard; drained by the session supervisor into typed STATE_FAULT
+        # events).
+        self.state_faults: List[dict] = []
+        self.sdc_detected_total = 0
+        self.sdc_repaired_total = 0
         # Device dispatches enqueued (jitted executable launches — the
         # per-tick count is the honest host-cost denominator the bench
         # reports; round-4 verdict weak #2/#3).
@@ -170,9 +185,23 @@ class RollbackRunner:
             if step.adv is not None:
                 if self._input_log is not None:
                     self._input_log[frame] = np.asarray(step.adv.bits)
+                self._used_inputs[frame] = (
+                    np.asarray(step.adv.bits),
+                    np.asarray(step.adv.status, np.int32),
+                )
                 frame += 1
 
         n = len(steps)
+        if load_frame is not None and self.verify_restores:
+            from bevy_ggrs_tpu import integrity
+
+            if not integrity.verify_row(self.ring, load_frame):
+                # The rollback's target row no longer hashes to its
+                # save-time digest: typed SDC detection on the restore
+                # path. Self-heal the ring first (raises StateFault when
+                # unrepairable), then let the original segment resimulate
+                # from the repaired row.
+                self.attest_and_repair(session)
         if n == 0 and load_frame is not None:
             # Bare Load with no resimulation steps: still restore the state.
             from bevy_ggrs_tpu.state import ring_load
@@ -251,6 +280,114 @@ class RollbackRunner:
         else:
             self._ledger_note = None
         self.frame = frame
+        horizon = self.frame - (self.max_prediction + 4)
+        for f in [f for f in self._used_inputs if f < horizon]:
+            del self._used_inputs[f]
+
+    # ------------------------------------------------------------------
+    # SDC attestation + rollback-powered repair (bevy_ggrs_tpu.integrity)
+
+    def attest_and_repair(self, session=None) -> dict:
+        """Attest every occupied ring row against its save-time digest;
+        on mismatch, restore the deepest clean snapshot and resimulate to
+        the live frame from the as-used input log (determinism makes the
+        recomputed rows — and the recomputed live state — bitwise equal to
+        the originals, which the returned report's ``bitwise`` flag
+        witnesses via the live-state digest). Raises
+        :class:`~bevy_ggrs_tpu.integrity.StateFault` when no clean base or
+        no inputs cover the span — the caller escalates (donor transfer /
+        fleet checkpoint). Reuses the already-warmed rollout executable at
+        its compiled shapes: zero recompiles on every repair path."""
+        from bevy_ggrs_tpu import integrity
+
+        mask = integrity.attest_ring(self.ring)
+        report = {
+            "corrupt_frames": [], "repaired": 0, "repair_frames": 0,
+            "bitwise": None, "first_corrupt_field": None,
+        }
+        if not mask.any():
+            return report
+        frames_h = np.asarray(self.ring.frames)
+        corrupt = sorted(int(f) for f in frames_h[mask])
+        report["corrupt_frames"] = corrupt
+        self.sdc_detected_total += len(corrupt)
+        self.metrics.count("sdc_detected", len(corrupt))
+        cset = set(corrupt)
+        clean_below = sorted(
+            int(f) for f in frames_h[frames_h >= 0]
+            if int(f) < corrupt[0] and int(f) not in cset
+        )
+
+        def _fail(detail: str) -> None:
+            fault = integrity.StateFault("sdc", corrupt, detail=detail)
+            self.state_faults.append({
+                "reason": "sdc", "frames": corrupt, "repaired": False,
+                "bitwise": False, "field": None, "detail": detail,
+            })
+            self.metrics.count("sdc_unrepairable")
+            raise fault
+
+        if corrupt[-1] >= self.frame:
+            _fail(f"corrupt row at frame {corrupt[-1]} >= live frame "
+                  f"{self.frame} — resimulation cannot reach it")
+        if not clean_below:
+            _fail("no digest-clean snapshot below the corrupt rows")
+        base = clean_below[-1]
+        used = []
+        for f in range(base, self.frame):
+            got = self._used_inputs.get(f)
+            if got is None:
+                _fail(f"as-used input log does not cover frame {f}")
+            used.append(got)
+        before = integrity.host_row(self.ring, corrupt[0] % self.ring.depth)
+        pre_live = np.asarray(integrity._state_digest(self.state))
+        n = len(used)
+        with self.metrics.timer("sdc_repair"), self.tracer.span(
+            "sdc_repair", frames=n
+        ):
+            pos = base
+            while pos < self.frame:
+                take = min(self.frame - pos, self.max_prediction + 2)
+                chunk = used[pos - base : pos - base + take]
+                bits = np.stack([b for b, _ in chunk])
+                status = np.stack([st for _, st in chunk])
+                self.device_dispatches_total += 1
+                self.ring, self.state, _cs = self.executor.run(
+                    self.ring, self.state, pos, bits, status,
+                    n_frames=take,
+                    load_frame=base if pos == base else None,
+                    save_mask=np.ones(take, bool),
+                    adv_mask=np.ones(take, bool),
+                )
+                pos += take
+        post_live = np.asarray(integrity._state_digest(self.state))
+        after = integrity.host_row(self.ring, corrupt[0] % self.ring.depth)
+        report["first_corrupt_field"] = integrity.first_corrupt_field(
+            before, after
+        )
+        report["repaired"] = len(corrupt)
+        report["repair_frames"] = n
+        report["bitwise"] = bool(
+            (pre_live == post_live).all()
+            and not integrity.attest_ring(self.ring).any()
+        )
+        self.sdc_repaired_total += len(corrupt)
+        self.metrics.count("sdc_repaired", len(corrupt))
+        if report["bitwise"]:
+            self.metrics.count("sdc_repaired_bitwise", len(corrupt))
+        self.metrics.observe("sdc_repair_frames", n)
+        self.state_faults.append({
+            "reason": "sdc", "frames": corrupt, "repaired": True,
+            "bitwise": report["bitwise"],
+            "field": report["first_corrupt_field"],
+        })
+        invalidate = getattr(self, "invalidate_speculation", None)
+        if invalidate is not None:
+            # Pending branch rollouts were built from pre-repair buffers;
+            # the repaired timeline is bitwise identical, but dropping them
+            # costs one speculation round and removes any doubt.
+            invalidate()
+        return report
 
     # ------------------------------------------------------------------
 
@@ -290,6 +427,9 @@ class RollbackRunner:
         # n_frames=0: every step masked invalid — compiles without touching
         # the live ring/state (results discarded).
         self.executor.run(self.ring, self.state, 0, bits, status, n_frames=0)
+        from bevy_ggrs_tpu import integrity
+
+        integrity.warm(self.ring, state=self.state)
 
     def world(self):
         """Host copy of the current world (the confirmed-state scatter-back

@@ -69,7 +69,7 @@ from bevy_ggrs_tpu.predict.model import resolve_predictor
 from bevy_ggrs_tpu.runner import RollbackRunner, _Step
 from bevy_ggrs_tpu.schedule import PREDICTED, Schedule
 from bevy_ggrs_tpu.serve.faults import SlotFault, SlotTicket
-from bevy_ggrs_tpu.session.requests import RestoreGameState
+from bevy_ggrs_tpu.session.requests import AdvanceFrame, RestoreGameState
 from bevy_ggrs_tpu.spec_runner import SpeculativeRollbackRunner
 from bevy_ggrs_tpu.state import SnapshotRing, WorldState, combine64, ring_init
 
@@ -384,6 +384,11 @@ class BatchedSessionCore:
         )
         if self._ranker is not None:
             self._ranker.warmup(self.num_slots, self.num_players)
+        from bevy_ggrs_tpu import integrity
+
+        # SDC attestation digests (integrity.attest/repair_slot) must not
+        # compile on the serving path either.
+        integrity.warm(self.rings, states=self.states)
 
     def admit(
         self,
@@ -919,3 +924,110 @@ class BatchedSessionCore:
         horizon = s.frame - self.ring_depth - 64
         for f in [f for f in s.input_log if f < horizon]:
             del s.input_log[f]
+
+    # -- SDC attestation + repair (bevy_ggrs_tpu.integrity) -------------
+
+    def attest(self) -> Dict[int, List[int]]:
+        """Attest every active slot's ring rows in ONE vmapped digest pass
+        over the ``[S, depth]`` axes (amortized over the batch exactly like
+        the checksum stream). Returns ``{slot: sorted corrupt frames}`` —
+        empty when every occupied row still hashes to its save-time
+        digest."""
+        from bevy_ggrs_tpu import integrity
+
+        mask = integrity.attest_ring(self.rings)  # [S, depth] host bools
+        out: Dict[int, List[int]] = {}
+        if not mask.any():
+            return out
+        frames_h = np.asarray(self.rings.frames)
+        for s in self.slots:
+            if not s.active:
+                continue  # dead rows: stale until readmission overwrites
+            rows = np.flatnonzero(mask[s.index])
+            if rows.size:
+                bad = sorted(int(f) for f in frames_h[s.index][rows])
+                out[s.index] = bad
+                self.metrics.count("sdc_detected", len(bad))
+                self.metrics.count(
+                    "sdc_detected", len(bad), labels={"match_slot": s.index}
+                )
+        return out
+
+    def repair_slot(self, slot: int, corrupt: List[int],
+                    session=None) -> dict:
+        """Self-heal one slot's corrupt ring rows by rollback
+        resimulation: one canonical burst (Load deepest-clean base, then
+        (Save, Advance) per frame from the slot's as-used input log)
+        through the ordinary batched dispatch — every occupied row sits
+        within ``ring_depth`` of the live frame, so the whole span fits one
+        burst and the repair costs exactly one no-recompile dispatch.
+        Sibling slots ride the no-op lane, bitwise untouched. Statuses
+        resimulate as zeros: committed states are functions of the input
+        BITS alone (the batched/singleton parity contract), so the rewrite
+        is bitwise. Raises :class:`~bevy_ggrs_tpu.integrity.StateFault`
+        when no clean base exists or the log has gaps — the caller
+        escalates (MatchServer drains the slot to a recovery lane /
+        checkpoint)."""
+        from bevy_ggrs_tpu import integrity
+
+        s = self.slots[slot]
+        if not s.active:
+            raise RuntimeError(f"slot {slot} is not active")
+        corrupt = sorted(int(f) for f in corrupt)
+        frames_h = np.asarray(self.rings.frames)[slot]
+        cset = set(corrupt)
+
+        def _fail(detail: str):
+            self.metrics.count("sdc_unrepairable")
+            raise integrity.StateFault("sdc", corrupt, slot=slot,
+                                       detail=detail)
+
+        if corrupt[-1] >= s.frame:
+            _fail(f"corrupt row at frame {corrupt[-1]} >= live frame "
+                  f"{s.frame} — resimulation cannot reach it")
+        clean_below = sorted(
+            int(f) for f in frames_h[frames_h >= 0]
+            if int(f) < corrupt[0] and int(f) not in cset
+        )
+        if not clean_below:
+            _fail("no digest-clean snapshot below the corrupt rows")
+        base = clean_below[-1]
+        steps = []
+        for f in range(base, s.frame):
+            bits = s.input_log.get(f)
+            if bits is None:
+                _fail(f"as-used input log does not cover frame {f}")
+            steps.append(_Step(
+                save_frame=f,
+                adv=AdvanceFrame(bits, np.zeros(self.num_players, np.int32)),
+            ))
+        row = corrupt[0] % self.ring_depth
+        before = integrity.host_row(self.rings, row, slot=slot)
+        pre_live = np.asarray(integrity._states_digests(self.states))[slot]
+        # Pending branches were rolled out from pre-repair buffers; drop
+        # them so the dispatch skips branch-match and rolls fresh ones.
+        s.res_anchor, s.res_bits = None, None
+        with self.metrics.timer("sdc_repair"), self.tracer.span(
+            "sdc_repair", slot=slot, frames=len(steps)
+        ):
+            self._dispatch({slot: (base, steps, None, session)})
+        post_live = np.asarray(integrity._states_digests(self.states))[slot]
+        after = integrity.host_row(self.rings, row, slot=slot)
+        post_mask = integrity.attest_ring(self.rings)[slot]
+        report = {
+            "slot": slot,
+            "corrupt_frames": corrupt,
+            "repaired": len(corrupt),
+            "repair_frames": len(steps),
+            "bitwise": bool(
+                (pre_live == post_live).all() and not post_mask.any()
+            ),
+            "first_corrupt_field": integrity.first_corrupt_field(
+                before, after
+            ),
+        }
+        self.metrics.count("sdc_repaired", len(corrupt))
+        if report["bitwise"]:
+            self.metrics.count("sdc_repaired_bitwise", len(corrupt))
+        self.metrics.observe("sdc_repair_frames", len(steps))
+        return report

@@ -546,6 +546,38 @@ def pack_match_record(codec, snap: Dict) -> bytes:
     return buf.getvalue()
 
 
+def _corruption_as_value_error(origin: str):
+    """Context manager normalizing every way a bit-flipped npz blob can
+    fail to parse (zip structure, zlib stream, truncated member, mangled
+    JSON header, missing key) into the one typed ``ValueError`` the
+    callers' corruption contract promises — a flipped bit must surface as
+    "corrupt checkpoint", never as an incidental decoder exception that an
+    outer handler misclassifies as a bug."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        import zipfile
+        import zlib as _zlib
+
+        try:
+            yield
+        except ValueError:
+            raise  # already the typed contract (digest/template mismatch)
+        except (
+            zipfile.BadZipFile,
+            _zlib.error,
+            OSError,
+            EOFError,
+            KeyError,
+            json.JSONDecodeError,
+            UnicodeDecodeError,
+        ) as e:
+            raise ValueError(f"corrupt {origin}: {e!r}") from e
+
+    return cm()
+
+
 def unpack_match_record(codec, blob: bytes) -> Dict:
     """Inverse of :func:`pack_match_record`: verify version, codec layout
     and payload digest, then rebuild the ticket. Raises ``ValueError`` on
@@ -555,7 +587,9 @@ def unpack_match_record(codec, blob: bytes) -> Dict:
 
     from bevy_ggrs_tpu.relay.delta import payload_digest
 
-    with np.load(io.BytesIO(blob)) as npz:
+    with _corruption_as_value_error("migration blob"), np.load(
+        io.BytesIO(blob)
+    ) as npz:
         header = json.loads(bytes(npz[_HEADER_KEY]).decode())
         _verify_header(header, codec, "migration blob")
         (entry,) = header["matches"]
@@ -585,7 +619,9 @@ def load_checkpoint_matches(path: str, codec) -> List[Dict]:
     from bevy_ggrs_tpu.relay.delta import payload_digest
 
     out: List[Dict] = []
-    with np.load(path) as npz:
+    with _corruption_as_value_error(
+        f"server checkpoint {path!r}"
+    ), np.load(path) as npz:
         header = json.loads(bytes(npz[_HEADER_KEY]).decode())
         _verify_header(header, codec, f"server checkpoint {path!r}")
         for entry in header["matches"]:
@@ -648,6 +684,8 @@ class ServerCheckpointer:
         os.makedirs(directory, exist_ok=True)
         self.saves_total = 0
         self.last_save_path: Optional[str] = None
+        # Corrupt-checkpoint skips during restore (newest-first fallback).
+        self.load_fallbacks = 0
 
     # -- saving ----------------------------------------------------------
 
@@ -722,13 +760,42 @@ class ServerCheckpointer:
         """Re-seed a freshly built server from the newest (or named)
         checkpoint. Returns the re-established MatchHandles. Raises
         ``ValueError`` on digest/template mismatch — a corrupted checkpoint
-        must never silently produce a plausible fleet."""
-        path = path if path is not None else self.latest()
-        if path is None:
-            raise ValueError(f"no server checkpoint in {self.directory!r}")
+        must never silently produce a plausible fleet.
+
+        Corruption fallback (the bottom rung of docs/serving.md's
+        self-healing ladder): when no explicit ``path`` is named and the
+        newest checkpoint fails its integrity checks, older retained
+        checkpoints are tried newest-first — the rolling ``keep`` window
+        exists precisely so one corrupt file costs ``interval`` frames of
+        staleness, not the fleet. Every skip is counted in
+        ``load_fallbacks``. An explicitly named ``path`` never falls back
+        (the caller asked for THAT file)."""
         codec = server.state_codec()
+        if path is not None:
+            records = load_checkpoint_matches(path, codec)
+        else:
+            candidates = [p for _, p in reversed(self._checkpoints())]
+            if not candidates:
+                raise ValueError(
+                    f"no server checkpoint in {self.directory!r}"
+                )
+            records = None
+            errors: List[str] = []
+            for cand in candidates:
+                try:
+                    records = load_checkpoint_matches(cand, codec)
+                    path = cand
+                    break
+                except ValueError as e:
+                    self.load_fallbacks += 1
+                    errors.append(f"{cand!r}: {e}")
+            if records is None:
+                raise ValueError(
+                    "every retained server checkpoint failed integrity "
+                    "verification: " + "; ".join(errors)
+                )
         handles = []
-        for rec in load_checkpoint_matches(path, codec):
+        for rec in records:
             key = rec["key"]
             att = attachments.get(key)
             if att is None:

@@ -132,6 +132,7 @@ class MatchServer:
         admit_budget: int = 4,
         admission_slo_ms: Optional[float] = None,
         ledger=None,
+        attest_interval: Optional[int] = 64,
     ):
         from bevy_ggrs_tpu.obs.ledger import null_ledger
         from bevy_ggrs_tpu.obs.slo import SlotSLO, WindowSLO
@@ -271,6 +272,15 @@ class MatchServer:
         self.fleet_addr = fleet_addr
         self.heartbeat_interval = max(1, int(heartbeat_interval))
         self.heartbeats_sent = 0
+        # SDC attestation cadence in served frames (None disables): every
+        # interval, one vmapped digest pass per group re-verifies all ring
+        # rows; mismatches self-heal in place via repair_slot, escalating
+        # unrepairable slots to the recovery-lane / checkpoint ladder
+        # (docs/serving.md#self-healing). Detection latency <= interval.
+        self.attest_interval = (
+            None if attest_interval is None else max(1, int(attest_interval))
+        )
+        self.sdc_repairs_total = 0
 
     def _flat_slot(self, handle: MatchHandle) -> int:
         """Server-wide slot id (group-qualified) — the SLO/metrics key.
@@ -771,6 +781,67 @@ class MatchServer:
             last_error=repr(lane.last_error),
         )
 
+    # -- SDC attestation (bevy_ggrs_tpu.integrity) ----------------------
+
+    def _attest_sweep(self) -> None:
+        """One silent-corruption sweep over every group and recovery lane:
+        recompute all ring-row digests (one vmapped pass per group),
+        self-heal mismatched slots in place via ``repair_slot`` (one
+        no-recompile dispatch each, siblings untouched), and escalate
+        anything unrepairable down the ladder — batched slot -> recovery
+        lane (``_fault(reason="sdc")``), lane -> the eviction/checkpoint
+        rung. A repair that lands bitwise keeps the match on the batch:
+        quarantine-free."""
+        from bevy_ggrs_tpu.integrity import StateFault
+
+        for g, core in enumerate(self.groups):
+            with self.tracer.span("attest", group=g):
+                detected = core.attest()
+            for slot, bad in detected.items():
+                handle = MatchHandle(g, slot)
+                m = self._matches.get(handle)
+                if m is None or handle in self._lanes:
+                    continue
+                try:
+                    rep = core.repair_slot(slot, bad)
+                except StateFault as e:
+                    self._fault(handle, m, "sdc", cause=e)
+                    continue
+                self.sdc_repairs_total += 1
+                self.tracer.instant(
+                    "sdc_repair", group=g, slot=slot,
+                    frames=rep["repair_frames"], bitwise=rep["bitwise"],
+                    field=rep["first_corrupt_field"] or "",
+                )
+                if not rep["bitwise"]:
+                    # Dispatched but did not land bitwise: the slot's
+                    # timeline can no longer be trusted on the batch.
+                    self._fault(handle, m, "sdc_nonbitwise")
+        for handle, lane in list(self._lanes.items()):
+            runner = lane.runner
+            attest = getattr(runner, "attest_and_repair", None)
+            if attest is None:
+                continue
+            try:
+                with self.tracer.span(
+                    "attest", group=handle.group, slot=handle.slot
+                ):
+                    attest()
+            except StateFault as e:
+                # Lane state unrepairable locally: strike the lane's error
+                # ladder — persistent corruption rides it to eviction, and
+                # the fleet checkpoint rung re-seats the match.
+                lane.errors += 1
+                lane.last_error = e
+            for rec in runner.state_faults:
+                self.tracer.instant(
+                    "sdc_fault", group=handle.group, slot=handle.slot,
+                    repaired=bool(rec.get("repaired")),
+                    bitwise=bool(rec.get("bitwise")),
+                    field=rec.get("field") or "",
+                )
+            runner.state_faults.clear()
+
     # -- crash-restart checkpoints --------------------------------------
 
     def snapshot_matches(self) -> List[Dict]:
@@ -944,6 +1015,13 @@ class MatchServer:
                             self._finish_admission(
                                 h, self._pending_first.pop(h)
                             )
+        # Periodic SDC attestation sweep, off the hot path like the lanes:
+        # detection within attest_interval frames, self-healing in place.
+        if (
+            self.attest_interval is not None
+            and self.frames_served % self.attest_interval == 0
+        ):
+            self._attest_sweep()
         # Recovery lanes: off the hot path, after every group dispatched.
         now = self._clock()
         # Group head frames — a lane's recovery debt is how far it trails

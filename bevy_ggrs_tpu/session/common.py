@@ -97,6 +97,13 @@ class EventKind(enum.Enum):
     PLAYER_REJOINED = "player_rejoined"  # data: {"handle": h}
     QUARANTINED = "quarantined"  # local peer lost the checksum vote
     RECOVERED = "recovered"  # quarantine healed via state transfer
+    # Silent-data-corruption attestation (bevy_ggrs_tpu.integrity): a ring
+    # row's recomputed digest disagreed with its save-time digest. data:
+    # {"reason": "sdc", "frames": [...], "repaired": bool, "bitwise": bool,
+    # "field": first corrupt field or None}. repaired+bitwise incidents are
+    # informational (the repair landed bitwise — no quarantine); repaired
+    # False means the supervisor escalated to a donor transfer.
+    STATE_FAULT = "state_fault"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -129,6 +129,10 @@ class PeerEndpoint:
         # Version-skew accounting (the datagrams themselves are dropped).
         self.version_mismatches = 0
         self._version_mismatch_reported = False
+        # v5 data-plane CRC drops: corrupt datagrams detected by the
+        # trailer check. Dropped like loss (redundant spans re-deliver);
+        # counted so wire corruption is a visible rate, not silent.
+        self.data_crc_drops = 0
         # Config-digest skew accounting (handshake legs refused, typed).
         self.config_mismatches = 0
         self._config_mismatch_reported = False
@@ -283,7 +287,12 @@ class PeerEndpoint:
     def note_undecodable(self, data: bytes) -> None:
         """Called with a datagram ``decode`` rejected: if it was OUR magic at
         a different version (vs plain garbage), count it toward the skew
-        alarm."""
+        alarm; if it was a v5 data-plane frame whose crc32 trailer failed,
+        count it as a detected wire-corruption drop."""
+        if proto.crc_mismatch(data):
+            self.data_crc_drops += 1
+            self.metrics.count("data_crc_drops")
+            return
         skew = proto.version_mismatch(data)
         if skew is not None:
             self.note_version_mismatch(skew)

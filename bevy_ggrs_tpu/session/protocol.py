@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import struct
+import zlib
 from typing import List, Optional, Tuple, Union
 
 import numpy as np
@@ -38,7 +39,17 @@ MAGIC = 0x47  # 'G'
 # prediction config attestable at handshake time: a peer running different
 # weights is refused with a typed CONFIG_MISMATCH event instead of playing
 # on with silently different recovery economics.
-VERSION = 4
+# v5: the data plane (types 1-8) gains a crc32 trailer over the whole frame,
+# header included. Every OTHER family already carried integrity somewhere
+# (StateChunk.crc, StreamDelta.crc, MigrateChunk.crc, CtrlFrame.crc) but a
+# bit flip inside an InputMsg used to decode cleanly and inject a genuinely
+# wrong input — REAL transport divergence that surfaced as a desync ballot.
+# From v5 a corrupt data-plane datagram fails the trailer check and is
+# dropped+counted (see crc_mismatch / PeerEndpoint.data_crc_drops),
+# indistinguishable from loss, which the input-span redundancy already
+# absorbs. Frame layout changed (4 trailing bytes), so this is a version
+# bump: a v4 peer gets a typed VERSION_MISMATCH refusal, never a desync.
+VERSION = 5
 
 # Heartbeat staleness is a bounded reorder window on beat_seq, not a bare
 # monotonic compare. Heartbeats travel unenveloped (the next beat is their
@@ -101,6 +112,17 @@ STATE_KIND_RING = 0  # world snapshot at one settled frame (desync resync)
 STATE_KIND_FULL = 1  # full runner+session checkpoint (crash-restart rejoin)
 
 _HDR = struct.Struct("<BBB")  # magic, version, type
+
+# v5 data-plane integrity: these frame types carry a crc32 trailer computed
+# over the whole encoded frame (header included, trailer excluded). The set
+# is exactly the types that previously had NO integrity guard of their own;
+# types 9+ each carry a per-chunk crc or digest already, and heartbeats
+# (type 22) are deliberately unenveloped (BEAT_REORDER_WINDOW absorbs them).
+DATA_PLANE_TYPES = frozenset((
+    T_SYNC_REQUEST, T_SYNC_REPLY, T_INPUT, T_INPUT_ACK,
+    T_QUALITY_REPORT, T_QUALITY_REPLY, T_KEEP_ALIVE, T_CHECKSUM_REPORT,
+))
+_CRC = struct.Struct("<I")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -462,6 +484,13 @@ _CTRL_ACK = struct.Struct("<I")  # seq
 
 
 def encode(msg: Message) -> bytes:
+    data = _encode(msg)
+    if data[2] in DATA_PLANE_TYPES:
+        data += _CRC.pack(zlib.crc32(data) & 0xFFFFFFFF)
+    return data
+
+
+def _encode(msg: Message) -> bytes:
     if isinstance(msg, SyncRequest):
         return _HDR.pack(MAGIC, VERSION, T_SYNC_REQUEST) + _SYNC.pack(
             msg.nonce, msg.config_digest & 0xFFFFFFFFFFFFFFFF
@@ -604,6 +633,22 @@ def version_mismatch(data: bytes) -> Optional[int]:
     return None
 
 
+def crc_mismatch(data: bytes) -> bool:
+    """True when this datagram is a well-headed v5 data-plane frame whose
+    crc32 trailer does not verify — i.e. a corruption *detected* by the v5
+    guard (as opposed to garbage that never parsed a header, or a version
+    skew, which version_mismatch covers). :func:`decode` drops these;
+    callers count them (``data_crc_drops``) so wire corruption is visible
+    as a rate instead of masquerading as plain loss."""
+    if len(data) < _HDR.size + _CRC.size:
+        return False
+    magic, version, mtype = _HDR.unpack_from(data)
+    if magic != MAGIC or version != VERSION or mtype not in DATA_PLANE_TYPES:
+        return False
+    (trailer,) = _CRC.unpack_from(data, len(data) - _CRC.size)
+    return (zlib.crc32(data[: -_CRC.size]) & 0xFFFFFFFF) != trailer
+
+
 def decode(data: bytes) -> Optional[Message]:
     """Parse one datagram; returns None for garbage / version mismatch
     (untrusted network input — never raise)."""
@@ -614,6 +659,16 @@ def decode(data: bytes) -> Optional[Message]:
         if magic != MAGIC or version != VERSION:
             return None
         body = data[_HDR.size :]
+        if mtype in DATA_PLANE_TYPES:
+            # v5: verify the crc32 trailer over header+body before ANY
+            # field parse. Truncation, bit flips and trailing garbage all
+            # land here and read as loss, which rollback already absorbs.
+            if len(data) < _HDR.size + _CRC.size:
+                return None
+            (trailer,) = _CRC.unpack_from(data, len(data) - _CRC.size)
+            if (zlib.crc32(data[: -_CRC.size]) & 0xFFFFFFFF) != trailer:
+                return None
+            body = data[_HDR.size : -_CRC.size]
         if mtype == T_SYNC_REQUEST:
             nonce, digest = _SYNC.unpack_from(body)
             return SyncRequest(nonce, digest)

@@ -92,6 +92,7 @@ class SessionSupervisor:
         vote_timeout: float = 0.5,
         request_interval: float = 0.3,
         tracer=None,
+        attest_interval: Optional[int] = 60,
     ):
         from bevy_ggrs_tpu.obs.trace import null_tracer
         from bevy_ggrs_tpu.utils.metrics import null_metrics
@@ -109,6 +110,15 @@ class SessionSupervisor:
         self.serve_state = serve_state
         self.vote_timeout = float(vote_timeout)
         self.request_interval = float(request_interval)
+        # SDC attestation cadence in frames (None disables): every
+        # ``attest_interval`` runner frames, recompute every occupied ring
+        # row's digest and self-heal mismatches via rollback resimulation
+        # (runner.attest_and_repair). Detection latency is bounded by this
+        # interval — docs/serving.md#self-healing.
+        self.attest_interval = (
+            None if attest_interval is None else int(attest_interval)
+        )
+        self._last_attest_frame = 0
 
         self.health = Health.HEALTHY
         self._interrupted: set = set()
@@ -212,6 +222,7 @@ class SessionSupervisor:
             )
             self._rejoin_donor = None
 
+        self._attest(now, events)
         self._decide_votes(now, events)
         self._drive_transfer(now, events)
 
@@ -246,6 +257,74 @@ class SessionSupervisor:
                 self._pending_votes[frame] = now + self.vote_timeout
         elif ev.kind == EventKind.PLAYER_REJOINED:
             self.metrics.count("players_rejoined")
+
+    # ------------------------------------------------------------------
+    # SDC attestation (bevy_ggrs_tpu.integrity)
+
+    def _attest(self, now: float, events: List[SessionEvent]) -> None:
+        """Periodic silent-corruption sweep: every ``attest_interval``
+        frames recompute the ring's row digests and self-heal any mismatch
+        by rollback resimulation. A repair that lands bitwise needs no
+        quarantine (the timeline provably never diverged); an unrepairable
+        fault escalates to the same donor-transfer rung as a lost desync
+        vote."""
+        runner = self.runner
+        if (
+            self.attest_interval is None
+            or not hasattr(runner, "attest_and_repair")
+            or self.health in (Health.QUARANTINED, Health.RESTORING)
+        ):
+            self._drain_state_faults(events)
+            return
+        if runner.frame - self._last_attest_frame >= self.attest_interval:
+            self._last_attest_frame = runner.frame
+            from bevy_ggrs_tpu import integrity
+
+            try:
+                with self.tracer.span("attest"):
+                    runner.attest_and_repair(self.session)
+            except integrity.StateFault:
+                self.on_state_fault(now=now)
+        self._drain_state_faults(events)
+
+    def _drain_state_faults(self, events: List[SessionEvent]) -> None:
+        faults = getattr(self.runner, "state_faults", None)
+        if not faults:
+            return
+        for rec in faults:
+            self.metrics.count("sdc_faults")
+            events.append(SessionEvent(EventKind.STATE_FAULT, data=dict(rec)))
+        faults.clear()
+
+    def on_state_fault(self, fault=None, now: Optional[float] = None) -> bool:
+        """Unrepairable local SDC (``integrity.StateFault`` — no clean
+        snapshot below the corrupt rows, or the input log no longer covers
+        the resimulation span): the ring can no longer prove its own
+        timeline. Remedy is the lost-desync-vote path — quarantine, adopt a
+        digest-verified settled snapshot from a donor, replay forward
+        (escalation rung 2 of docs/serving.md's ladder: ring repair ->
+        donor transfer -> fleet checkpoint). Apps whose drive loop catches
+        StateFault from ``runner.handle_requests`` call this directly.
+        Returns True when a donor transfer was started."""
+        now = self._clock() if now is None else now
+        if self.health in (Health.QUARANTINED, Health.RESTORING):
+            return False
+        donor = next(
+            (
+                a
+                for a in set(self.session._handle_addr.values())
+                if self.session._endpoints[a].state == PeerState.RUNNING
+            ),
+            None,
+        )
+        self.metrics.count("sdc_escalations")
+        if donor is None:
+            # No live donor: the fleet checkpoint rung (serve/faults.py /
+            # fleet supervisor restore) owns this incident.
+            return False
+        self._set_health(Health.QUARANTINED)
+        self._begin_transfer(donor, proto.STATE_KIND_RING, now)
+        return True
 
     # ------------------------------------------------------------------
     # Desync vote

@@ -146,6 +146,18 @@ def test_three_peer_chaos_soak_2000_frames():
     assert {"loss", "reorder", "duplicate", "corrupt", "partition"} <= kinds
     assert len(faults) > 50
 
+    # Protocol v5: the Corrupt window's bit-flipped datagrams never decoded
+    # — every one was dropped at the endpoint and counted, making wire
+    # corruption indistinguishable from loss (which rollback absorbs).
+    # Before v5 these flips decoded as genuinely wrong inputs and produced
+    # real desyncs the supervisor had to quarantine-and-heal; now the soak
+    # demands ZERO desyncs under the exact same plan.
+    assert sum(
+        ep.data_crc_drops for s in sessions for ep in s._endpoints.values()
+    ) > 0
+    for m in mets:
+        assert m.counters.get("desyncs_detected", 0) == 0
+
 
 def test_two_peer_generated_plan_smoke():
     """Non-slow CI guard: a generated plan (the --chaos-seed path) over a

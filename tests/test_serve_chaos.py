@@ -27,6 +27,7 @@ import pytest
 from bevy_ggrs_tpu.chaos import (
     ChaosPlan,
     ChaosSocket,
+    CheckpointCorrupt,
     Corrupt,
     Duplicate,
     KillRestart,
@@ -34,7 +35,9 @@ from bevy_ggrs_tpu.chaos import (
     Partition,
     Reorder,
     ServerKillRestart,
+    SnapshotCorrupt,
 )
+from bevy_ggrs_tpu import integrity
 from bevy_ggrs_tpu.models import box_game
 from bevy_ggrs_tpu.obs import (
     FlightRecorder,
@@ -131,6 +134,10 @@ def build_server(ckpt_dir, capacity, groups, net, metrics, tracer=None,
         metrics=metrics, clock=lambda: net.now, tracer=tracer,
         checkpoint_dir=ckpt_dir, checkpoint_interval=120,
         ledger=ledger,
+        # A tight attestation cadence (every 4 frames vs the ring's depth
+        # of MAX_PRED+1 rows) so harness-injected SnapshotCorrupt bit
+        # flips are caught while the corrupt row is still resident.
+        attest_interval=4,
     )
     server.warmup()
     return server
@@ -276,6 +283,43 @@ def run_served_soak(
          "killed": False, "done": False}
         for k in plan.server_kill_restarts()
     ]
+    # StateFault directives run at the harness level too (a socket can't
+    # reach device memory): SnapshotCorrupt flips one checksum-covered bit
+    # in the target match's on-device ring row, CheckpointCorrupt flips a
+    # bit in the newest on-disk server checkpoint. Both are seeded from
+    # the plan so the injection is replayable.
+    sdc_rng = np.random.RandomState(plan.seed ^ 0x5DC)
+    snaps = [{"at": d.at, "target": d.target, "done": False}
+             for d in plan.snapshot_corrupts()]
+    ckcs = [{"at": d.at, "done": False}
+            for d in plan.checkpoint_corrupts()]
+
+    def inject_snapshot(d):
+        if server is None:
+            return False
+        m = d["target"][1] if d["target"] is not None else 0
+        h = handle_of.get(m)
+        if h is None or h in server._lanes:
+            return False
+        core = server.groups[h.group]
+        s = core.slots[h.slot]
+        if not s.active:
+            return False
+        frames_h = np.asarray(core.rings.frames)[h.slot]
+        # A mid-depth resident row: old enough that the save already
+        # settled, young enough to survive until the next attest sweep.
+        rows = np.flatnonzero(
+            (frames_h >= 0) & (frames_h <= s.frame - 3)
+            & (frames_h >= s.frame - 5)
+        )
+        if rows.size == 0:
+            return False
+        row = int(rows[0])
+        core.rings, info = integrity.flip_ring_bit(
+            core.rings, row, sdc_rng, slot=h.slot
+        )
+        faults.append((net.now, "snapshot_corrupt", info))
+        return True
     recorders = (
         {"server": FlightRecorder(),
          **{m: FlightRecorder() for m in ext}}
@@ -300,7 +344,14 @@ def run_served_soak(
         for k in skrs:
             if not k["killed"] and net.now >= k["at"]:
                 # kill -9: no flush, no farewell — sockets just go dark.
+                # Harvest the dying host sessions' CRC-drop counts into the
+                # (restart-surviving) Metrics first: chaos corruption is
+                # tx-side on the ext sockets, so the server end is where
+                # the v5 trailer check catches it.
                 for match in server._matches.values():
+                    for ep in match.session._endpoints.values():
+                        if ep.data_crc_drops:
+                            metrics.count("data_crc_drops", ep.data_crc_drops)
                     match.session.socket.close()
                 server = None
                 k["killed"] = True
@@ -323,6 +374,25 @@ def run_served_soak(
                     p[0].current_frame for p in ext.values()
                 )
                 k["done"] = True
+        for d in snaps:
+            if not d["done"] and net.now >= d["at"]:
+                d["done"] = inject_snapshot(d)
+        for d in ckcs:
+            if not d["done"] and net.now >= d["at"]:
+                ckpts = sorted(
+                    f for f in os.listdir(ckpt_dir)
+                    if f.startswith("server_ckpt_") and f.endswith(".npz")
+                )
+                if ckpts:
+                    newest = max(
+                        ckpts, key=lambda f: int(f[len("server_ckpt_"):-4])
+                    )
+                    info = integrity.flip_file_bit(
+                        os.path.join(ckpt_dir, newest), sdc_rng
+                    )
+                    if info is not None:
+                        faults.append((net.now, "checkpoint_corrupt", info))
+                        d["done"] = True
         if server is not None:
             server.run_frame()
             if recorders:
@@ -598,12 +668,14 @@ def test_served_relay_trace_spans_three_component_tracks(tmp_path):
 # The slow acceptance soak: S=16 under full chaos
 # ---------------------------------------------------------------------------
 
-# No Corrupt window here, deliberately: InputMsg carries no CRC, so a
-# bit-flipped input datagram decodes cleanly and injects a *genuinely*
-# wrong input — a real transport-level divergence the supervisor detects
-# and heals (covered by test_chaos_soak.py). This soak isolates the serve
-# tier's claim instead: under loss/reorder/duplication/partition and both
-# kill-restart classes, the batched path itself introduces ZERO desyncs.
+# Corrupt windows are allowed everywhere since protocol v5: every
+# data-plane frame (inputs included) carries a crc32 trailer, so a
+# bit-flipped datagram never decodes — it is dropped and counted
+# (``data_crc_drops``), indistinguishable from loss, which rollback
+# already absorbs. The StateFault family rides along: one snapshot-ring
+# bit flip on the batch (self-healed bitwise by the attestation sweep,
+# quarantine-free) and one checkpoint-file bit flip while the server is
+# down (the restore falls back to the next-newest clean checkpoint).
 SOAK_PLAN = ChaosPlan(
     2025,
     (
@@ -611,9 +683,12 @@ SOAK_PLAN = ChaosPlan(
         LossBurst(8.0, 10.0, 0.25),
         Reorder(3.0, 6.0, 0.2, delay=0.05),
         Duplicate(5.0, 7.0, 0.3),
+        Corrupt(2.5, 9.5, 0.05),
         Partition(6.0, 6.5, src=("ext", 3)),
         KillRestart(4.0, ("ext", 0), 1.5),
         ServerKillRestart(11.0, "server", 1.5),
+        SnapshotCorrupt(7.6, ("ext", 1)),
+        CheckpointCorrupt(12.0, "server"),
     ),
 )
 
@@ -658,9 +733,40 @@ def test_serve_chaos_soak_s16(tmp_path):
     assert all(v <= 600 for v in recoveries)
     assert server.cache_size() == 1
 
-    # The plan actually injected chaos of every scripted network kind.
+    # The plan actually injected chaos of every scripted kind — including
+    # wire corruption and both StateFault flavors.
     kinds = {k for _, k, _ in faults}
-    assert {"loss", "reorder", "duplicate", "partition"} <= kinds
+    assert {
+        "loss", "reorder", "duplicate", "corrupt", "partition",
+        "snapshot_corrupt", "checkpoint_corrupt",
+    } <= kinds
+
+    # v5 data-plane integrity: corrupted datagrams were dropped-and-counted
+    # at the endpoints (never decoded), on both sides of the wire.
+    drops = sum(
+        ep.data_crc_drops
+        for peer in ext.values()
+        for ep in peer[0]._endpoints.values()
+    ) + sum(
+        ep.data_crc_drops
+        for m in server._matches.values()
+        for ep in m.session._endpoints.values()
+    ) + int(metrics.counters.get("data_crc_drops", 0))
+    assert drops > 0
+
+    # The snapshot bit flip was detected by the attestation sweep and
+    # repaired bitwise, in place, quarantine-free — no fault escalation,
+    # and the serial replay below proves the repaired match's checksums
+    # are exactly what an uninterrupted run would have produced.
+    assert metrics.counters["sdc_detected"] >= 1
+    assert metrics.counters["sdc_repaired"] >= 1
+    assert (metrics.counters["sdc_repaired_bitwise"]
+            == metrics.counters["sdc_repaired"])
+    assert metrics.counters.get("sdc_unrepairable", 0) == 0
+
+    # The corrupted newest checkpoint was refused by the digest-guarded
+    # loader; the restart restored from the next-newest clean one.
+    assert server.checkpointer.load_fallbacks >= 1
 
     # Independent serial replay: rebuild match 1's trajectory from nothing
     # but its canonical confirmed-input log; the reported checksums must

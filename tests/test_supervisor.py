@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from bevy_ggrs_tpu.chaos import ChaosPlan, ChaosSocket, Partition
+from bevy_ggrs_tpu.integrity import StateFault
 from bevy_ggrs_tpu.models import box_game
 from bevy_ggrs_tpu.runner import RollbackRunner
 from bevy_ggrs_tpu.session import (
@@ -73,6 +74,12 @@ def sup_step(net, peer, inputs_for, events=None):
         try:
             runner.handle_requests(session.advance_frame(), session)
         except PredictionThreshold:
+            break
+        except StateFault:
+            # The restore-path guard found unrepairable ring corruption:
+            # the documented drive contract (session/supervisor.py) is to
+            # hand the incident to the supervisor's escalation ladder.
+            sup.on_state_fault(now=net.now)
             break
 
 

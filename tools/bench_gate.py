@@ -211,6 +211,61 @@ def check_row(row: dict, base: Optional[dict],
                               f"child dead ({row.get('failovers')!r} "
                               "failovers; gate: 0)")
             return out
+    if metric.startswith("serve_sdc_"):
+        # The data-integrity row IS its gates: silent corruption that
+        # went undetected, a repair that did not land bitwise, a desync
+        # under the corrupt wire, a lost match, or a compile during
+        # repair churn is a regression regardless of the tick latency.
+        injected = row.get("sdc_injected")
+        if not isinstance(injected, (int, float)) or injected <= 0:
+            out.update(status="FAIL",
+                       detail=f"sdc row injected {injected!r} faults "
+                              "(gate: > 0 — the scenario went dead)")
+            return out
+        if row.get("sdc_detected") != injected:
+            out.update(status="FAIL",
+                       detail=f"attestation detected {row.get('sdc_detected')!r}"
+                              f" of {injected!r} injected faults (gate: all)")
+            return out
+        if row.get("sdc_repaired_bitwise") != row.get("sdc_repaired") or (
+            row.get("sdc_repaired") != injected
+        ):
+            out.update(status="FAIL",
+                       detail=f"repairs {row.get('sdc_repaired')!r} / bitwise "
+                              f"{row.get('sdc_repaired_bitwise')!r} of "
+                              f"{injected!r} (gate: every repair bitwise)")
+            return out
+        if row.get("sdc_unrepairable") != 0:
+            out.update(status="FAIL",
+                       detail=f"{row.get('sdc_unrepairable')!r} slots were "
+                              "unrepairable in place (gate: 0)")
+            return out
+        drops = row.get("data_crc_drops")
+        if not isinstance(drops, (int, float)) or drops <= 0:
+            out.update(status="FAIL",
+                       detail=f"wire segment counted {drops!r} crc drops "
+                              "(gate: > 0 — the corrupt window went dead)")
+            return out
+        if row.get("desyncs") != 0:
+            out.update(status="FAIL",
+                       detail=f"sdc row saw {row.get('desyncs')!r} desyncs "
+                              "under the corrupt wire (gate: 0)")
+            return out
+        if row.get("matches_lost") != 0:
+            out.update(status="FAIL",
+                       detail=f"sdc row lost {row.get('matches_lost')!r} "
+                              "matches (gate: 0)")
+            return out
+        if row.get("churn_recompiles") != 0:
+            out.update(status="FAIL",
+                       detail="sdc repair churn compiled "
+                              f"{row.get('churn_recompiles')!r}x (gate: 0)")
+            return out
+        for col in ("repair_frames_p50", "repair_frames_p99"):
+            if not isinstance(row.get(col), (int, float)):
+                out.update(status="FAIL",
+                           detail=f"sdc row lost its {col} column")
+                return out
     if metric.startswith("front_door_"):
         # The saturation-ladder row IS its health gates: a knee measured
         # with slot faults, compiles during admission churn, or a lost
