@@ -21,6 +21,7 @@ from bevy_ggrs_tpu.chaos.plan import (
     MigrateMatch,
     Partition,
     RelayKillRestart,
+    RelayTreeKill,
     Reorder,
     ServerDrain,
     ServerKillRestart,
@@ -42,6 +43,7 @@ __all__ = [
     "MigrateMatch",
     "Partition",
     "RelayKillRestart",
+    "RelayTreeKill",
     "Reorder",
     "ServerDrain",
     "ServerKillRestart",

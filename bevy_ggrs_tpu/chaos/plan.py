@@ -221,10 +221,28 @@ class CheckpointCorrupt:
     target: object = None
 
 
+@dataclasses.dataclass(frozen=True)
+class RelayTreeKill:
+    """Script a MID-TIER relay death inside a relay tree: the non-root
+    relay at address ``relay`` dies at ``at`` (crash semantics — its
+    sockets close, no goodbye) and stays down for ``down_for`` seconds.
+    Unlike :class:`RelayKillRestart` the victim is a TREE member, so the
+    harness must also exercise the re-home ladder: orphaned child relays
+    and spectators of the dead relay re-home to a sibling/grandparent
+    and resume from their client-side cursors (zero desync, bounded
+    resume lag; see tests/test_relay_tree.py). Harness-level execution,
+    replayable from the plan like the rest of the kill family."""
+
+    at: float
+    relay: object
+    down_for: float
+
+
 Directive = Union[
     LossBurst, Reorder, Duplicate, Corrupt, Partition, KillRestart,
     RelayKillRestart, ServerKillRestart, BalancerPartition, MigrateMatch,
     ServerLoss, ServerSpawn, ServerDrain, SnapshotCorrupt, CheckpointCorrupt,
+    RelayTreeKill,
 ]
 
 _KINDS = {
@@ -243,6 +261,7 @@ _KINDS = {
     "server_drain": ServerDrain,
     "snapshot_corrupt": SnapshotCorrupt,
     "checkpoint_corrupt": CheckpointCorrupt,
+    "relay_tree_kill": RelayTreeKill,
 }
 _NAMES = {cls: name for name, cls in _KINDS.items()}
 
@@ -347,12 +366,22 @@ class ChaosPlan:
             key=lambda d: d.at,
         )
 
+    def relay_tree_kills(self) -> List[RelayTreeKill]:
+        return sorted(
+            (d for d in self.directives if isinstance(d, RelayTreeKill)),
+            key=lambda d: d.at,
+        )
+
     def horizon(self) -> float:
         """Time at which the last directive has expired/healed."""
         t = 0.0
         for d in self.directives:
             if isinstance(
-                d, (KillRestart, RelayKillRestart, ServerKillRestart)
+                d,
+                (
+                    KillRestart, RelayKillRestart, ServerKillRestart,
+                    RelayTreeKill,
+                ),
             ):
                 t = max(t, d.at + d.down_for)
             elif isinstance(
@@ -410,6 +439,7 @@ class ChaosPlan:
         elastic: bool = False,
         control: bool = False,
         sdc: bool = False,
+        relay_tree: Tuple[object, ...] = (),
     ) -> "ChaosPlan":
         """A deterministic mixed-fault schedule over ``duration`` seconds:
         a few loss bursts, one reorder window, one duplication window, one
@@ -441,7 +471,11 @@ class ChaosPlan:
         schedule): two :class:`SnapshotCorrupt` silent bit flips targeting
         peers (or fleet members when no peers are named), and — when a
         ``match_server`` or ``fleet`` exists to own checkpoint files — one
-        :class:`CheckpointCorrupt` late in the run."""
+        :class:`CheckpointCorrupt` late in the run. When ``relay_tree``
+        names ≥1 MID-TIER relay addresses, one :class:`RelayTreeKill` of
+        a random member is appended LAST of all (after the sdc family),
+        so every pre-tree plan a seed ever produced stays
+        byte-identical."""
         rng = np.random.RandomState(seed & 0x7FFFFFFF)
         span = max(float(duration), 1.0)
         d: List[Directive] = []
@@ -551,4 +585,14 @@ class ChaosPlan:
                 # so the restore fallback has somewhere to land.
                 t0 = float(rng.uniform(0.6 * span, 0.85 * span))
                 d.append(CheckpointCorrupt(t0, tgt))
+        if relay_tree:
+            # Relay-tree family — drawn LAST of all (after the sdc
+            # draws), preserving byte-identity of every pre-tree plan.
+            # Mid-run, so the tree is warm (keyframes cached, chains
+            # flowing) when the mid-tier relay dies and the re-home
+            # ladder has runway to prove zero-desync resume.
+            victim = relay_tree[int(rng.randint(0, len(relay_tree)))]
+            t0 = float(rng.uniform(0.35 * span, 0.6 * span))
+            d.append(RelayTreeKill(
+                t0, victim, float(rng.uniform(0.04, 0.08) * span)))
         return cls(seed, tuple(d))

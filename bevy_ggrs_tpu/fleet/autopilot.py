@@ -64,12 +64,21 @@ __all__ = [
     "BalancerFleet",
     "FleetAutopilot",
     "FleetObservation",
+    "RelayAutopilot",
+    "RelayAutopilotConfig",
+    "RelayObservation",
+    "RelayPolicy",
+    "RelaySample",
     "ServerSample",
     "heartbeat_score",
     "observation_from_json",
     "observation_to_json",
+    "relay_observation_from_json",
+    "relay_observation_to_json",
     "replay_ledger",
+    "replay_relay_ledger",
     "verify_ledger",
+    "verify_relay_ledger",
 ]
 
 
@@ -830,17 +839,378 @@ class FleetAutopilot:
         return len(self.ledger)
 
 
+# ---------------------------------------------------------------------------
+# Relay-tier elasticity: the same discipline applied to fan-out capacity
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RelaySample:
+    """One relay's state as the relay policy sees it. ``parent_id`` is
+    None for the root; ``alive=False`` on a sample means the relay's
+    PARENT is gone (an orphan needing a re-home) — a fully dead relay is
+    simply absent from the observation, like a dead server."""
+
+    relay_id: int
+    tier: int
+    parent_id: Optional[int]
+    subscribers: int
+    capacity: int
+    alive: bool = True
+    draining: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class RelayObservation:
+    tick: int
+    relays: Dict[int, RelaySample]
+
+
+@dataclasses.dataclass(frozen=True)
+class RelayAutopilotConfig:
+    """Fan-out watermarks are subscriber fill over serving capacity of
+    the elastic (non-root) tier; the gap + confirm streaks + one scale
+    cooldown are the same no-flap guarantee the fleet policy carries."""
+
+    high_watermark: float = 0.80
+    low_watermark: float = 0.35
+    confirm_beats: int = 3
+    cooldown_scale_ticks: int = 60
+    min_relays: int = 1
+    max_relays: int = 8
+
+
+class RelayPolicy:
+    """Pure decision core for relay-tier elasticity:
+    ``decide(RelayObservation) -> [AutopilotAction]``, deterministic by
+    construction (streaks + cooldown stamps only, sorted iteration).
+    Decision order per tick: re-home orphans (topology health first),
+    scale-up, retire drained-empty relays, scale-down initiation.
+    Relay capacity is deliberately a SEPARATE policy from match-serving
+    capacity (the Podracer decoupling): one match's fan-out can scale
+    from one relay to a tree and back without the match fleet noticing."""
+
+    def __init__(self, config: Optional[RelayAutopilotConfig] = None):
+        self.config = config or RelayAutopilotConfig()
+        self._high_streak = 0
+        self._low_streak = 0
+        self._last_scale_tick: Optional[int] = None
+        self._refused: set = set()
+        self._rehomed: set = set()
+
+    def _rehome_target(
+        self, obs: RelayObservation, orphan: RelaySample
+    ) -> Optional[int]:
+        """The re-home ladder over observed ids: the closest live,
+        non-draining relay strictly above the orphan (highest tier =
+        a sibling of the dead parent, then the grandparent's level),
+        lowest id within a tier — deterministic across replays."""
+        candidates = [
+            r for r in obs.relays.values()
+            if r.alive and not r.draining
+            and r.relay_id != orphan.relay_id
+            and r.tier < orphan.tier
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda r: (-r.tier, r.relay_id)).relay_id
+
+    def decide(self, obs: RelayObservation) -> List[AutopilotAction]:
+        cfg = self.config
+        acts: List[AutopilotAction] = []
+        relays = obs.relays
+        # The elastic tier: live non-root relays. A sample with
+        # alive=False is an orphan (its parent died) — still serving
+        # from its buffer, but needing a new upstream.
+        orphans = sorted(
+            r.relay_id for r in relays.values()
+            if not r.alive and r.parent_id is not None
+        )
+        serving = [
+            r for r in relays.values()
+            if r.alive and not r.draining and r.parent_id is not None
+        ]
+
+        # 0) Re-home orphans before any capacity arithmetic: a subtree
+        #    cut off from the root serves stale frames no matter how
+        #    well-sized it is. One action per orphan per episode.
+        for rid in orphans:
+            if rid in self._rehomed:
+                continue
+            dst = self._rehome_target(obs, relays[rid])
+            if dst is None:
+                self._refuse_once(acts, ("rehome", rid), AutopilotAction(
+                    "refuse", obs.tick,
+                    f"relay {rid} lost its parent but no live relay "
+                    "above it remains to re-home to",
+                    server_id=rid,
+                ))
+                continue
+            self._rehomed.add(rid)
+            acts.append(AutopilotAction(
+                "relay_rehome", obs.tick,
+                f"relay {rid}'s parent died; re-homing its subtree to "
+                f"relay {dst} (resume from the client-side cursor, "
+                "zero desync)",
+                server_id=rid, dst_id=dst,
+            ))
+        self._rehomed &= set(orphans)
+        for rid in list(self._refused):
+            if isinstance(rid, tuple) and rid[0] == "rehome" \
+                    and rid[1] not in orphans:
+                self._refused.discard(rid)
+
+        total_subs = sum(r.subscribers for r in serving)
+        total_cap = sum(max(1, r.capacity) for r in serving)
+        fill = total_subs / total_cap if total_cap else 1.0
+        in_cooldown = (
+            self._last_scale_tick is not None
+            and obs.tick - self._last_scale_tick < cfg.cooldown_scale_ticks
+        )
+
+        # 1) Scale-up: fan-out fill above the high watermark.
+        if fill >= cfg.high_watermark and len(serving) < cfg.max_relays:
+            self._high_streak += 1
+        else:
+            self._high_streak = 0
+            self._refused.discard(("scale", "up"))
+        if self._high_streak >= cfg.confirm_beats:
+            if in_cooldown:
+                self._refuse_once(acts, ("scale", "up"), AutopilotAction(
+                    "refuse", obs.tick,
+                    f"cooldown: fan-out fill {fill:.2f} >= "
+                    f"{cfg.high_watermark} but last scale action was "
+                    f"{obs.tick - self._last_scale_tick} ticks ago "
+                    f"(< {cfg.cooldown_scale_ticks})",
+                ))
+            else:
+                acts.append(AutopilotAction(
+                    "relay_spawn", obs.tick,
+                    f"fan-out fill {fill:.2f} >= high watermark "
+                    f"{cfg.high_watermark} for {self._high_streak} beat(s); "
+                    "spawning a relay child",
+                ))
+                self._last_scale_tick = obs.tick
+                self._high_streak = 0
+                self._low_streak = 0
+                self._refused.discard(("scale", "up"))
+
+        # 2) Drain progress: a draining relay that has emptied retires.
+        for r in sorted(
+            (r for r in relays.values() if r.alive and r.draining),
+            key=lambda r: r.relay_id,
+        ):
+            if r.subscribers == 0:
+                acts.append(AutopilotAction(
+                    "relay_retire", obs.tick,
+                    f"relay {r.relay_id} drained empty; retiring",
+                    server_id=r.relay_id,
+                ))
+
+        # 3) Scale-down initiation — never while another drain is open.
+        draining_open = any(
+            r.draining for r in relays.values() if r.alive
+        )
+        if (
+            fill <= cfg.low_watermark
+            and len(serving) > cfg.min_relays
+            and not draining_open
+        ):
+            self._low_streak += 1
+        else:
+            self._low_streak = 0
+            self._refused.discard(("scale", "down"))
+        if self._low_streak >= cfg.confirm_beats:
+            if in_cooldown:
+                self._refuse_once(acts, ("scale", "down"), AutopilotAction(
+                    "refuse", obs.tick,
+                    f"cooldown: fan-out fill {fill:.2f} <= "
+                    f"{cfg.low_watermark} but last scale action was "
+                    f"{obs.tick - self._last_scale_tick} ticks ago "
+                    f"(< {cfg.cooldown_scale_ticks})",
+                ))
+            else:
+                victim = min(
+                    serving, key=lambda r: (r.subscribers, -r.relay_id)
+                )
+                acts.append(AutopilotAction(
+                    "relay_drain", obs.tick,
+                    f"fan-out fill {fill:.2f} <= low watermark "
+                    f"{cfg.low_watermark} for {self._low_streak} beats; "
+                    f"draining emptiest relay {victim.relay_id} "
+                    f"({victim.subscribers} subscribers)",
+                    server_id=victim.relay_id,
+                ))
+                self._last_scale_tick = obs.tick
+                self._low_streak = 0
+                self._high_streak = 0
+                self._refused.discard(("scale", "down"))
+        return acts
+
+    # Refusal audit discipline shared with AutopilotPolicy.
+    _refuse_once = AutopilotPolicy._refuse_once
+
+
+def relay_observation_to_json(obs: RelayObservation) -> dict:
+    return {
+        "tick": obs.tick,
+        "relays": {
+            str(rid): dataclasses.asdict(r)
+            for rid, r in sorted(obs.relays.items())
+        },
+    }
+
+
+def relay_observation_from_json(raw: dict) -> RelayObservation:
+    return RelayObservation(
+        tick=int(raw["tick"]),
+        relays={
+            int(rid): RelaySample(**r) for rid, r in raw["relays"].items()
+        },
+    )
+
+
+def _split_relay_header(
+    recs: List[dict], config: Optional[RelayAutopilotConfig]
+) -> Tuple[Optional[RelayAutopilotConfig], List[dict]]:
+    if recs and "config" in recs[0] and "observation" not in recs[0]:
+        if config is None:
+            config = RelayAutopilotConfig(**recs[0]["config"])
+        recs = recs[1:]
+    return config, recs
+
+
+def replay_relay_ledger(
+    records, config: Optional[RelayAutopilotConfig] = None
+) -> List[List[AutopilotAction]]:
+    config, recs = _split_relay_header(_load_ledger(records), config)
+    policy = RelayPolicy(config)
+    return [
+        policy.decide(relay_observation_from_json(rec["observation"]))
+        for rec in recs
+    ]
+
+
+def verify_relay_ledger(
+    records, config: Optional[RelayAutopilotConfig] = None
+) -> Tuple[bool, int]:
+    """Determinism check for a relay-elasticity ledger: the recorded
+    spawn→fan-out→drain arc must re-derive bit-identically from its
+    observations alone."""
+    config, recs = _split_relay_header(_load_ledger(records), config)
+    replayed = replay_relay_ledger(recs, config)
+    for rec, acts in zip(recs, replayed):
+        if [_action_to_json(a) for a in acts] != rec["actions"]:
+            return False, len(recs)
+    return True, len(recs)
+
+
+class RelayAutopilot:
+    """The closed loop over a relay-tree adapter (``relay_samples /
+    spawn_relay / drain_relay / retire_relay / rehome``) — RelayTree
+    in-process, ProcRelayTier over subprocess UDP relays. Appends the
+    same replayable JSONL record shape as :class:`FleetAutopilot`, with
+    a ``kind: relay`` config header so the CLI harness routes the trace
+    to the right policy."""
+
+    def __init__(
+        self,
+        fleet,
+        config: Optional[RelayAutopilotConfig] = None,
+        metrics=None,
+        tracer=None,
+    ):
+        from bevy_ggrs_tpu.obs.trace import null_tracer
+        from bevy_ggrs_tpu.utils.metrics import null_metrics
+
+        self.fleet = fleet
+        self.config = config or RelayAutopilotConfig()
+        self.policy = RelayPolicy(self.config)
+        self.metrics = metrics if metrics is not None else null_metrics
+        self.tracer = tracer if tracer is not None else null_tracer
+        self.ledger: List[dict] = []
+        self.actions: List[AutopilotAction] = []
+        self.counts: Dict[str, int] = {}
+
+    def observe(self, tick: int) -> RelayObservation:
+        return RelayObservation(
+            tick=int(tick), relays=dict(self.fleet.relay_samples())
+        )
+
+    def _execute(self, a: AutopilotAction) -> bool:
+        if a.kind == "relay_spawn":
+            return bool(self.fleet.spawn_relay())
+        if a.kind == "relay_drain":
+            return bool(self.fleet.drain_relay(a.server_id))
+        if a.kind == "relay_retire":
+            return bool(self.fleet.retire_relay(a.server_id))
+        if a.kind == "relay_rehome":
+            return bool(self.fleet.rehome(a.server_id, a.dst_id))
+        return True  # refuse: the recorded decision IS the act
+
+    def step(self, tick: int) -> List[AutopilotAction]:
+        obs = self.observe(tick)
+        actions = self.policy.decide(obs)
+        executed = []
+        for a in actions:
+            ok = self._execute(a)
+            executed.append(bool(ok))
+            self.counts[a.kind] = self.counts.get(a.kind, 0) + 1
+            self.metrics.count(f"autopilot_{a.kind}")
+            self.tracer.instant(
+                f"autopilot_{a.kind}",
+                reason=a.reason, relay=a.server_id, dst=a.dst_id,
+                executed=ok,
+            )
+        self.actions.extend(actions)
+        self.ledger.append({
+            "tick": int(tick),
+            "observation": relay_observation_to_json(obs),
+            "actions": [_action_to_json(a) for a in actions],
+            "executed": executed,
+        })
+        return actions
+
+    def export_jsonl(self, path: str) -> int:
+        with open(path, "w") as f:
+            f.write(json.dumps({
+                "config": dataclasses.asdict(self.config),
+                "kind": "relay",
+            }) + "\n")
+            for rec in self.ledger:
+                f.write(json.dumps(rec) + "\n")
+        return len(self.ledger)
+
+
+def _ledger_kind(recs: List[dict]) -> str:
+    """Sniff whether a ledger is a fleet or a relay-elasticity trace:
+    the exported header says so; headerless records are sniffed from the
+    observation shape."""
+    if recs and "config" in recs[0] and "observation" not in recs[0]:
+        return recs[0].get("kind", "fleet")
+    for rec in recs:
+        if "observation" in rec:
+            return "relay" if "relays" in rec["observation"] else "fleet"
+    return "fleet"
+
+
 def _main(argv: List[str]) -> int:
     """``python -m bevy_ggrs_tpu.fleet.autopilot <ledger.jsonl>``: replay
-    a recorded heartbeat trace through a fresh policy and report whether
-    the decisions reproduce (the offline determinism check)."""
+    a recorded trace (fleet or relay-tier) through a fresh policy and
+    report whether the decisions reproduce (the offline determinism
+    check)."""
     if not argv:
         print("usage: python -m bevy_ggrs_tpu.fleet.autopilot "
               "<autopilot_ledger.jsonl>")
         return 2
     recs = _load_ledger(argv[0])
-    ok, ticks = verify_ledger(recs)
-    n_actions = sum(len(r["actions"]) for r in _split_header(recs, None)[1])
+    if _ledger_kind(recs) == "relay":
+        ok, ticks = verify_relay_ledger(recs)
+        body = _split_relay_header(recs, None)[1]
+    else:
+        ok, ticks = verify_ledger(recs)
+        body = _split_header(recs, None)[1]
+    n_actions = sum(len(r["actions"]) for r in body)
     print(f"ticks={ticks} actions={n_actions} "
           f"replay={'IDENTICAL' if ok else 'DIVERGED'}")
     return 0 if ok else 1

@@ -712,7 +712,9 @@ class ServerProcess:
     """One supervised child: Popen + non-blocking stdout event pump +
     stdin command pipe. ``kill()`` is the crash lever (SIGKILL, no
     goodbye — detection is the heartbeat-timeout path); ``stop()`` is
-    the graceful lifecycle."""
+    the graceful lifecycle. ``module`` selects the child entry point —
+    the relay tier (relay/tree.py) reuses this wrapper for its
+    subprocess relays."""
 
     def __init__(
         self,
@@ -720,6 +722,7 @@ class ServerProcess:
         config: dict,
         stderr_path: Optional[str] = None,
         env: Optional[dict] = None,
+        module: str = "bevy_ggrs_tpu.fleet.proc",
     ):
         self.server_id = int(server_id)
         self.config = config
@@ -732,8 +735,7 @@ class ServerProcess:
             open(stderr_path, "ab") if stderr_path else subprocess.DEVNULL
         )
         self.proc = subprocess.Popen(
-            [sys.executable, "-m", "bevy_ggrs_tpu.fleet.proc",
-             json.dumps(config)],
+            [sys.executable, "-m", module, json.dumps(config)],
             stdin=subprocess.PIPE,
             stdout=subprocess.PIPE,
             stderr=self._stderr,

@@ -127,6 +127,44 @@ def _fleet_section(rows) -> str:
     )
 
 
+def _relay_tree_section(rows) -> str:
+    """Relay-tree topology table: one row per relay, indented by tier —
+    the dicts :meth:`~bevy_ggrs_tpu.relay.tree.RelayTree.topology_rows`
+    produces."""
+    rows = list(rows)
+    if not rows:
+        return "<p class='small'>no relay-tree members</p>"
+    out = []
+    for r in sorted(rows, key=lambda r: (r.get("tier", 0), r.get("relay_id", 0))):
+        state = (
+            "dead" if not r.get("alive", True)
+            else ("draining" if r.get("draining") else "up")
+        )
+        state_cls = {"dead": "page", "draining": "warn", "up": "ok"}[state]
+        lag = r.get("lag_frames", 0)
+        hits = r.get("cache_hits", 0)
+        misses = r.get("cache_misses", 0)
+        corrupt = r.get("cache_corrupt", 0)
+        total = hits + misses
+        hit_rate = "" if not total else f"{100.0 * hits / total:.0f}%"
+        indent = " " * (2 * int(r.get("tier", 0)))
+        out.append([
+            f"{indent}relay {r.get('relay_id')} (tier {r.get('tier', 0)})",
+            (state, state_cls),
+            "" if r.get("parent") is None else str(r.get("parent")),
+            r.get("subscribers", ""),
+            r.get("frontier", ""),
+            (lag, "warn" if lag and lag > 2 else "ok"),
+            hit_rate,
+            (corrupt, "page" if corrupt else "ok"),
+        ])
+    return _table(
+        ["relay", "state", "parent", "subscribers", "frontier",
+         "lag (frames)", "kf-cache hit", "cache corrupt"],
+        out,
+    )
+
+
 def _spans_section(tracers: Dict[str, object]) -> str:
     parts = []
     for comp, tracer in sorted(tracers.items()):
@@ -356,6 +394,7 @@ def build_report(
     timeseries=None,
     ledger=None,
     fleet=None,
+    relay_tree=None,
     notes: Optional[str] = None,
 ) -> str:
     """Render the report; write it to ``path`` when given. ``slo`` is a
@@ -366,12 +405,18 @@ def build_report(
     or its ``snapshot()`` dict; ``ledger`` is a
     :class:`~bevy_ggrs_tpu.obs.ledger.SpeculationLedger` or its
     ``summary()`` dict; ``fleet`` is a list of per-server row dicts
-    (:meth:`~bevy_ggrs_tpu.fleet.balancer.FleetBalancer.fleet_rows`)."""
+    (:meth:`~bevy_ggrs_tpu.fleet.balancer.FleetBalancer.fleet_rows`);
+    ``relay_tree`` is a list of per-relay row dicts
+    (:meth:`~bevy_ggrs_tpu.relay.tree.RelayTree.topology_rows`)."""
     sections = []
     if notes:
         sections.append(f"<p>{_esc(notes)}</p>")
     if fleet is not None:
         sections.append("<h2>Fleet</h2>" + _fleet_section(fleet))
+    if relay_tree is not None:
+        sections.append(
+            "<h2>Relay tree</h2>" + _relay_tree_section(relay_tree)
+        )
     if slo is not None:
         snap = slo.snapshot() if hasattr(slo, "snapshot") else dict(slo)
         sections.append("<h2>Slot SLO state</h2>" + _slo_section(snap))

@@ -31,16 +31,27 @@ from bevy_ggrs_tpu.relay.delta import (
     delta_encode,
     payload_digest,
 )
-from bevy_ggrs_tpu.relay.server import RelayServer
+from bevy_ggrs_tpu.relay.server import KeyframeCache, RelayServer
 from bevy_ggrs_tpu.relay.stream import StatePublisher, StreamSpectator
+from bevy_ggrs_tpu.relay.tree import (
+    ProcRelayTier,
+    RelayProcess,
+    RelayTree,
+    TierLink,
+)
 
 __all__ = [
     "RELAY_CONTROL",
+    "KeyframeCache",
+    "ProcRelayTier",
+    "RelayProcess",
     "RelayServer",
     "RelaySocket",
+    "RelayTree",
     "StateCodec",
     "StatePublisher",
     "StreamSpectator",
+    "TierLink",
     "delta_apply",
     "delta_encode",
     "payload_digest",

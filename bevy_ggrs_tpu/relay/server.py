@@ -35,8 +35,10 @@ from __future__ import annotations
 
 import itertools
 import time as _time
+import zlib
 from typing import Callable, Dict, List, Optional, Tuple
 
+from bevy_ggrs_tpu.relay.delta import payload_digest
 from bevy_ggrs_tpu.session import protocol as proto
 from bevy_ggrs_tpu.session.common import NULL_FRAME
 from bevy_ggrs_tpu.utils.metrics import null_metrics
@@ -60,7 +62,7 @@ except Exception:  # pragma: no cover
 
     null_tracer = _NT()
 
-__all__ = ["RelayServer"]
+__all__ = ["KeyframeCache", "RelayServer"]
 
 # Relay-instance epochs: module-level counter keeps them unique (and
 # deterministic) within one process — a restarted relay gets a fresh epoch,
@@ -98,7 +100,13 @@ class _Stream:
 
     def add_keyframe(self, msg: proto.StreamKeyframe, raw: bytes) -> None:
         kf = self.keyframes.setdefault(
-            msg.frame, {"total": msg.total, "chunks": {}, "complete": False}
+            msg.frame,
+            {
+                "total": msg.total,
+                "chunks": {},
+                "complete": False,
+                "digest": msg.digest,
+            },
         )
         kf["chunks"][msg.seq] = raw
         if not kf["complete"] and len(kf["chunks"]) >= kf["total"]:
@@ -111,6 +119,84 @@ class _Stream:
             )
             for f in complete[: -self.keyframe_retention]:
                 self.keyframes.pop(f, None)
+
+
+class KeyframeCache:
+    """Shared keyframe cache, content-addressed by the 64-bit payload
+    digest every :class:`StreamKeyframe` chunk already carries on the
+    wire. N cold joins inside one keyframe interval cost ONE upstream
+    encode and N local re-sends of the same cached chunk datagrams.
+
+    Entries are validated at SERVE time, not insert time: each chunk's
+    crc32 must match its payload and the reassembled payload's digest
+    must equal the cache key. A cached entry that rots (bit-flip, bad
+    RAM, truncation) is therefore refused, purged, counted as
+    ``corrupt`` and the serve falls back to the live stream buffer —
+    the cache can never launder bytes the publisher didn't sign."""
+
+    def __init__(self, capacity: int = 4):
+        self.capacity = int(capacity)
+        # digest -> {"frame": int, "chunks": [raw, ...] in seq order}
+        self._entries: Dict[int, Dict] = {}
+        self._order: List[int] = []
+        self.hits = 0
+        self.misses = 0
+        self.corrupt = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, digest: int) -> bool:
+        return digest in self._entries
+
+    def put(self, digest: int, frame: int, chunks: List[bytes]) -> None:
+        if digest in self._entries:
+            return
+        self._entries[digest] = {"frame": frame, "chunks": list(chunks)}
+        self._order.append(digest)
+        while len(self._order) > self.capacity:
+            self._entries.pop(self._order.pop(0), None)
+
+    def purge(self, digest: int) -> None:
+        self._entries.pop(digest, None)
+        try:
+            self._order.remove(digest)
+        except ValueError:
+            pass
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self._order.clear()
+
+    def lookup(self, digest: int) -> Optional[List[bytes]]:
+        """Validated fetch: the raw chunk datagrams for ``digest``, or
+        ``None`` on miss OR on a corrupt entry (purged + counted)."""
+        entry = self._entries.get(digest)
+        if entry is None:
+            self.misses += 1
+            return None
+        payloads = []
+        for raw in entry["chunks"]:
+            msg = proto.decode(raw)
+            if (
+                not isinstance(msg, proto.StreamKeyframe)
+                or msg.digest != digest
+                or zlib.crc32(msg.payload) & 0xFFFFFFFF != msg.crc & 0xFFFFFFFF
+            ):
+                payloads = None
+                break
+            payloads.append((msg.seq, msg.payload))
+        if payloads is not None:
+            data = b"".join(p for _, p in sorted(payloads))
+            if payload_digest(data) != digest:
+                payloads = None
+        if payloads is None:
+            self.purge(digest)
+            self.corrupt += 1
+            self.misses += 1
+            return None
+        self.hits += 1
+        return entry["chunks"]
 
 
 class _Subscriber:
@@ -167,11 +253,51 @@ class RelayServer:
         self._rev: Dict[object, Tuple[int, int]] = {}
         self._streams: Dict[int, _Stream] = {}
         self._subs: Dict[object, _Subscriber] = {}
+        self.keyframe_cache = KeyframeCache()
+        self._cache_corrupt_seen = 0
+        # A draining relay (autopilot scale-down) serves existing
+        # subscribers but refuses NEW ones; it retires once empty.
+        self.draining = False
 
     # ------------------------------------------------------------------
 
     def subscriber_count(self) -> int:
         return len(self._subs)
+
+    def stream_head(self, session_id: int) -> int:
+        st = self._streams.get(session_id)
+        return st.head if st is not None else NULL_FRAME
+
+    def stream_latest_keyframe(self, session_id: int) -> Optional[int]:
+        st = self._streams.get(session_id)
+        return st.latest_keyframe if st is not None else None
+
+    def ingest(self, session_id: int, raw: bytes) -> bool:
+        """Feed one raw upstream stream datagram VERBATIM into the
+        per-session buffer — the tier-link path (relay/tree.py). A child
+        relay's TierLink already authenticated its parent by address, so
+        this bypasses the publisher registration (``_rev``) the socket
+        path demands. The datagram is stored unmodified, which is what
+        makes tree fan-out bitwise-exact at any depth."""
+        msg = proto.decode(raw)
+        if isinstance(msg, proto.StreamDelta):
+            self._stream(session_id).add_delta(msg, raw)
+            self.metrics.count("fanout_frames_buffered")
+            return True
+        if isinstance(msg, proto.StreamKeyframe):
+            self._stream(session_id).add_keyframe(msg, raw)
+            return True
+        self.metrics.count("relay_undecodable")
+        return False
+
+    def reset_stream(self, session_id: int) -> None:
+        """Drop the per-session stream buffer and the keyframe cache.
+        The tier link calls this when its upstream epoch breaks (parent
+        restart with a fresh stream): buffered frames and cached
+        keyframes from the old instance must not serve new joins."""
+        self._streams.pop(session_id, None)
+        self.keyframe_cache.clear()
+        self.metrics.count("fanout_stream_resets")
 
     def subscriber_mode(self, addr) -> Optional[str]:
         sub = self._subs.get(addr)
@@ -216,10 +342,23 @@ class RelayServer:
         self.metrics.count("relay_forwarded")
         self.metrics.count("relay_forwarded_bytes", len(raw))
 
+    def _chain_alive(self, stream: _Stream, acked: int) -> bool:
+        """True when a cursor at ``acked`` still chains: the next delta's
+        base is buffered, the cursor is at/past the newest keyframe, or
+        the cursor is already at the head (nothing to send)."""
+        return (
+            acked in stream.deltas
+            or acked >= stream.head
+            or (
+                stream.latest_keyframe is not None
+                and acked >= stream.latest_keyframe
+            )
+        )
+
     def _on_subscribe(self, msg: proto.Subscribe, addr, now: float) -> None:
         sub = self._subs.get(addr)
         if sub is None:
-            if len(self._subs) >= self.max_subscribers:
+            if len(self._subs) >= self.max_subscribers or self.draining:
                 self.metrics.count("fanout_subscribe_rejected")
                 return
             window = min(max(int(msg.window) or self.default_window, 1),
@@ -235,6 +374,19 @@ class RelayServer:
             sub.acked = max(sub.acked, msg.cursor)
             sub.last_ack_time = now
             self.metrics.count("fanout_resubscribed")
+            # Chain-aware resume: while the spectator was away (relay
+            # swap, shed-and-return bounce) this entry's ack frontier
+            # stalled and the ladder degraded it to KEYFRAME_ONLY. The
+            # stale rung must not outlive the absence: if the returning
+            # cursor still chains into the buffer, promote straight back
+            # to FULL — a warm failover costs zero keyframe bytes.
+            if sub.mode == MODE_KEYFRAME and sub.acked >= 0:
+                stream = self._streams.get(sub.session_id)
+                if stream is not None and self._chain_alive(stream, sub.acked):
+                    sub.mode = MODE_FULL
+                    sub.stall_pumps = 0
+                    sub.last_acked_value = sub.acked
+                    self.metrics.count("fanout_resumed_warm")
 
     # -- fan-out ---------------------------------------------------------
 
@@ -244,9 +396,31 @@ class RelayServer:
         kf = stream.keyframes.get(stream.latest_keyframe)
         if kf is None or not kf["complete"]:
             return 0
+        # Shared-keyframe cache: every serve of the same keyframe after
+        # the first comes out of the content-addressed cache — N cold
+        # joins in one interval cost one upstream encode, N local sends.
+        digest = kf.get("digest")
+        chunks: Optional[List[bytes]] = None
+        if digest is not None:
+            chunks = self.keyframe_cache.lookup(digest)
+            self.metrics.count(
+                "keyframe_cache_hits" if chunks is not None
+                else "keyframe_cache_misses"
+            )
+        if chunks is None:
+            chunks = [kf["chunks"][seq] for seq in sorted(kf["chunks"])]
+            if digest is not None:
+                if self.keyframe_cache.corrupt > self._cache_corrupt_seen:
+                    self.metrics.count(
+                        "keyframe_cache_corrupt",
+                        self.keyframe_cache.corrupt - self._cache_corrupt_seen,
+                    )
+                    self._cache_corrupt_seen = self.keyframe_cache.corrupt
+                self.keyframe_cache.put(
+                    digest, stream.latest_keyframe, chunks
+                )
         sent = 0
-        for seq in sorted(kf["chunks"]):
-            raw = kf["chunks"][seq]
+        for raw in chunks:
             self.socket.send_to(raw, sub.addr)
             self.metrics.count("fanout_bytes_sent", len(raw))
             sent += 1
@@ -268,10 +442,7 @@ class RelayServer:
             sub.last_acked_value = sub.acked
 
         if sub.mode == MODE_FULL:
-            chain_alive = sub.acked in stream.deltas or (
-                stream.latest_keyframe is not None
-                and sub.acked >= stream.latest_keyframe
-            )
+            chain_alive = self._chain_alive(stream, sub.acked)
             sustained_loss = (
                 sub.stall_pumps > self.degrade_after and behind > sub.window
             )

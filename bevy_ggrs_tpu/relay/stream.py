@@ -260,6 +260,24 @@ class StreamSpectator:
         self._last_data = now  # grace on the new relay
         self._subscribe(now)
 
+    def retarget(self, relays: List[object], now: Optional[float] = None) -> None:
+        """Re-home to a new relay list (tree re-home ladder: a dead
+        mid-tier relay's spectators move to a sibling or grandparent).
+        The resumable cursor is client-side state, so the swap is just
+        "subscribe over there with what I hold": when the new relay
+        still buffers the chain, the chain-aware resume promotes the
+        cursor straight to FULL and the swap costs zero keyframe
+        bytes."""
+        if not relays:
+            raise ValueError("StreamSpectator.retarget needs >= 1 relay")
+        self.relays = list(relays)
+        self._idx = 0
+        self.relay_addr = self.relays[0]
+        self.metrics.count("spectator_retargets")
+        now = self._clock() if now is None else now
+        self._last_data = now  # grace on the new tree position
+        self._subscribe(now)
+
     def _on_keyframe(self, msg: proto.StreamKeyframe) -> None:
         if msg.frame <= self.current_frame:
             return
@@ -302,6 +320,7 @@ class StreamSpectator:
                 continue
             if isinstance(msg, proto.StreamDelta):
                 got_data = True
+                self.metrics.count("stream_delta_bytes_received", len(raw))
                 self.head_seen = max(self.head_seen, msg.frame)
                 if msg.frame > self.current_frame:
                     self._pending[msg.base_frame] = (
@@ -309,6 +328,10 @@ class StreamSpectator:
                     )
             elif isinstance(msg, proto.StreamKeyframe):
                 got_data = True
+                # Split byte accounting per datagram class: the warm-
+                # failover contract ("zero keyframe bytes across a swap
+                # whose chain is contiguous") is pinned on this counter.
+                self.metrics.count("stream_keyframe_bytes_received", len(raw))
                 self.head_seen = max(self.head_seen, msg.frame)
                 self._on_keyframe(msg)
         if got_data:

@@ -289,6 +289,44 @@ def check_row(row: dict, base: Optional[dict],
                 out.update(status="FAIL",
                            detail=f"front-door row lost its {col} column")
                 return out
+    if metric.startswith("relay_tree_"):
+        # The tiered fan-out row IS its exactness gates: a spectator whose
+        # drained bytes differ from the authoritative publisher, a dead
+        # shared-keyframe cache (every cold join re-encoding upstream), a
+        # tier adding more than the 2-frame lag bound, or a tree that does
+        # not beat a single relay's capacity is a regression regardless of
+        # the pump latency.
+        if row.get("desyncs") != 0:
+            out.update(status="FAIL",
+                       detail=f"relay-tree row saw {row.get('desyncs')!r} "
+                              "spectators diverge from the authoritative "
+                              "stream (gate: 0)")
+            return out
+        hit_rate = row.get("keyframe_cache_hit_rate")
+        if not isinstance(hit_rate, (int, float)) or hit_rate <= 0:
+            out.update(status="FAIL",
+                       detail=f"shared-keyframe cache hit rate {hit_rate!r} "
+                              "(gate: > 0 — cold joins re-encoded upstream)")
+            return out
+        added = row.get("added_lag_frames_per_tier")
+        if not isinstance(added, (int, float)) or added > 2.0:
+            out.update(status="FAIL",
+                       detail=f"added lag per tier {added!r} frames "
+                              "(gate: <= 2)")
+            return out
+        ratio = row.get("vs_single_relay_capacity")
+        if not isinstance(ratio, (int, float)) or ratio < 3.0:
+            out.update(status="FAIL",
+                       detail=f"tree capacity {ratio!r}x a single relay "
+                              "(gate: >= 3x)")
+            return out
+        for col in ("tree_spectators_at_2f_lag",
+                    "bytes_per_spectator_per_sec",
+                    "spectator_lag_p99_frames", "tier_backlog_p99_frames"):
+            if not isinstance(row.get(col), (int, float)):
+                out.update(status="FAIL",
+                           detail=f"relay-tree row lost its {col} column")
+                return out
     if metric.startswith("live_") and "_spec_on" in metric or (
         metric.startswith("serve_batched_")
     ):
