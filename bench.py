@@ -2198,6 +2198,10 @@ def _serve_batched_case(model: str, S: int) -> dict:
         num_branches=B, spec_frames=F, ledger=ledger,
         **({"tracer": tracer} if tracer is not None else {}),
     )
+    # Arm the one-shot XLA cost capture before warmup so the AOT
+    # lowering's backend compile lands inside the warmup accounting
+    # window (a persistent-cache hit, not a churn recompile).
+    core._exec.enable_cost_capture(f"serve_batched_{model}_S{S}")
     core.warmup()
     slots = [core.admit() for _ in range(S)]
     scripts = {s: _serve_script(P, 1000 + s, ticks) for s in slots}
@@ -2302,7 +2306,10 @@ def _serve_batched_case(model: str, S: int) -> dict:
     # (batched device wait ~= S x the serial singleton's device wait —
     # measured, not asserted).
     serial_device = sprobe.device_ms / max(sprobe.dispatches, 1)
-    attribution = probe.result(lanes=S, serial_device_ms=serial_device)
+    attribution = probe.result(
+        lanes=S, serial_device_ms=serial_device,
+        cost=core._exec.cost() or None,
+    )
     attribution["attr_serial_device_ms"] = round(serial_device, 4)
 
     if td is not None:
@@ -3105,6 +3112,19 @@ def _front_door_case(S: int) -> dict:
     rtt0 = _host_device_rtt_ms()
     xla_cache.install_compile_listeners()
 
+    # GGRS_HOST_PROFILE=1 arms the span-aware sampling profiler around
+    # the ladder (started after warmup so compile time doesn't pollute
+    # the steady-state flame). One profiler covers the whole in-process
+    # fleet; server 0 carries it so the ops report gains the flame
+    # section and export_telemetry writes the folded/counter artifacts.
+    profiler = None
+    if os.environ.get("GGRS_HOST_PROFILE", "") not in ("", "0", "false"):
+        from bevy_ggrs_tpu.obs.profiler import HostProfiler
+
+        profiler = HostProfiler(
+            seed=S, pid=0, process_name=f"front_door_S{S}"
+        )
+
     def make_synctest():
         return (
             SessionBuilder(box_game.INPUT_SPEC)
@@ -3133,6 +3153,10 @@ def _front_door_case(S: int) -> dict:
             num_branches=B, spec_frames=F, capacity=CAP,
             stagger_groups=GROUPS, metrics=metrics,
             timeseries=tseries[k], clock=lambda: net.now, server_id=k,
+            **(
+                {"profiler": profiler}
+                if (profiler is not None and k == 0) else {}
+            ),
         )
         srv.warmup()
         bal.register(k, srv)
@@ -3167,6 +3191,8 @@ def _front_door_case(S: int) -> dict:
         serve_frame()
     compiles_base = xla_cache.compile_counters()["backend_compiles"]
     faults_base = metrics.counters.get("slot_faults", 0)
+    if profiler is not None:
+        profiler.start()
 
     def merged_window(name):
         vals = []
@@ -3259,6 +3285,8 @@ def _front_door_case(S: int) -> dict:
         else:
             break  # the ladder found its burn point
 
+    if profiler is not None:
+        profiler.stop()
     churn_recompiles = (
         xla_cache.compile_counters()["backend_compiles"] - compiles_base
     )
@@ -3291,6 +3319,18 @@ def _front_door_case(S: int) -> dict:
             stage_cols[f"{col}_p99_ms"] = round(
                 float(np.percentile(vals, 99)), 4
             )
+    # The row's compact profile blob: per-stage self-time tables the
+    # bench gate diffs for regression attribution, plus the attribution
+    # fractions the front-door acceptance bar checks.
+    prof_cols = {}
+    if profiler is not None:
+        prof_cols["profile"] = profiler.profile_blob()
+        prof_cols["profile_attributed_frac"] = round(
+            profiler.attributed_frac(), 4
+        )
+        prof_cols["profile_admission_attributed_frac"] = round(
+            profiler.attributed_frac("admission_"), 4
+        )
     td = _bench_trace_dir(f"front_door_S{S}")
     if td is not None:
         for k, srv in servers.items():
@@ -3315,6 +3355,7 @@ def _front_door_case(S: int) -> dict:
         admissions_rejected_at_knee=int(knee["rejected"]),
         churn_recompiles=int(churn_recompiles),
         **stage_cols,
+        **prof_cols,
         notes=(
             "open-loop Poisson arrival ladder through the balancer's "
             "paging-aware placement and the admit queue (budget-bounded "
@@ -3599,6 +3640,29 @@ def _fleet_autoscale_case(N: int, chaos: bool = False) -> dict:
             for m in fleet.members.values()
             if m.process.alive() and m.status is not None
         )
+        # XLA compile wall-time per child (utils/xla_cache.py listener
+        # totals, riding the status heartbeat): the scale-up latency
+        # row names how much of the child boot was backend compilation.
+        compile_ms = [
+            float((m.status or {}).get("xla_compile_ms"))
+            for m in fleet.members.values()
+            if m.status is not None
+            and (m.status or {}).get("xla_compile_ms") is not None
+        ]
+        hbm_peaks = [
+            int((m.status or {}).get("hbm_peak_bytes"))
+            for m in fleet.members.values()
+            if m.status is not None
+            and (m.status or {}).get("hbm_peak_bytes") is not None
+        ]
+        cost_cols = {}
+        if compile_ms:
+            cost_cols["xla_compile_ms_total"] = round(sum(compile_ms), 1)
+            cost_cols["xla_compile_ms_p50"] = round(
+                float(np.percentile(compile_ms, 50)), 1
+            )
+        if hbm_peaks:
+            cost_cols["hbm_peak_bytes"] = max(hbm_peaks)
         frames_total = sum(
             (m.status or {}).get("frames", 0)
             for m in fleet.members.values()
@@ -3648,6 +3712,7 @@ def _fleet_autoscale_case(N: int, chaos: bool = False) -> dict:
             matches_lost=int(fleet.matches_lost),
             failovers=int(fleet.failovers),
             churn_recompiles=int(churn_recompiles),
+            **cost_cols,
             ctrl_retransmits=int(fleet.ctrl_retransmits),
             epoch_fence_refusals=int(fleet.epoch_fence_refusals),
             degraded_beats=int(ap.degraded_beats),

@@ -193,6 +193,21 @@ class _Child:
         wire = SidecarSocket(self.rel, self.prov) if self.prov else self.rel
         self.wire = wire
 
+        # Fleet-soak profiling leg (GGRS_HOST_PROFILE=1, inherited from
+        # the parent's environment): a per-child sampling profiler over
+        # this child's serving thread, exported with the other telemetry
+        # artifacts at shutdown.
+        self.profiler = None
+        if os.environ.get("GGRS_HOST_PROFILE", "").lower() not in (
+            "", "0", "false"
+        ):
+            from bevy_ggrs_tpu.obs.profiler import HostProfiler
+
+            self.profiler = HostProfiler(
+                seed=self.sid, pid=700 + self.sid,
+                process_name=f"srv{self.sid}",
+            )
+
         parent = cfg.get("parent")
         t0 = time.perf_counter()
         self.server = MatchServer(
@@ -216,8 +231,11 @@ class _Child:
             checkpoint_interval=cfg["checkpoint_interval"],
             trace_dir=cfg.get("obs_dir"),
             ledger=ledger,
+            profiler=self.profiler,
         )
         self.server.warmup()
+        if self.profiler is not None:
+            self.profiler.start()
         self.warmup_s = time.perf_counter() - t0
         self.base_compiles = compile_counters()["backend_compiles"]
         self._emit(
@@ -258,6 +276,26 @@ class _Child:
         from bevy_ggrs_tpu.utils.xla_cache import compile_counters
 
         return compile_counters()["backend_compiles"] - self.base_compiles
+
+    def _cost_columns(self) -> dict:
+        """XLA cost-observatory columns for status/bye events: total
+        compile wall time this process has spent (the scale-up-latency
+        decomposition) and the peak executable HBM footprint when the
+        cost capture ran (GGRS_XLA_COST=1)."""
+        from bevy_ggrs_tpu.utils.xla_cache import (
+            compile_summary,
+            executable_costs,
+        )
+
+        out = {"xla_compile_ms": compile_summary()["total_ms"]}
+        hbm = [
+            rec["hbm_peak_bytes"]
+            for rec in executable_costs().values()
+            if rec.get("hbm_peak_bytes")
+        ]
+        if hbm:
+            out["hbm_peak_bytes"] = int(max(hbm))
+        return out
 
     # -- commands --------------------------------------------------------
 
@@ -590,6 +628,7 @@ class _Child:
             evictions=self.server.evictions_total,
             compiles=self._compiles(),
             draining=self.draining,
+            **self._cost_columns(),
             ctrl_retransmits=self.rel.retransmits,
             ctrl_crc_drops=self.rel.crc_drops,
             ctrl_dups_dropped=self.rel.duplicates_dropped,
@@ -599,6 +638,8 @@ class _Child:
         )
 
     def _shutdown(self) -> None:
+        if self.profiler is not None:
+            self.profiler.stop()
         artifacts = {}
         cfg = self.cfg
         if cfg.get("obs_dir"):
@@ -616,6 +657,7 @@ class _Child:
             event="bye",
             frames=self.server.frames_served,
             compiles=self._compiles(),
+            **self._cost_columns(),
             faults=self.server.faults_total,
             ctrl_retransmits=self.rel.retransmits,
             ctrl_crc_drops=self.rel.crc_drops,
@@ -1539,6 +1581,13 @@ class ProcFleet:
                     spec_waste_permille=hb.spec_waste_permille,
                     score=round(heartbeat_score(hb), 4),
                 )
+            # Cost-observatory columns ride the status events (the ops
+            # report's fleet table renders them when present).
+            st = m.status or {}
+            if st.get("xla_compile_ms") is not None:
+                row["xla_compile_ms"] = st["xla_compile_ms"]
+            if st.get("hbm_peak_bytes") is not None:
+                row["hbm_peak_bytes"] = st["hbm_peak_bytes"]
             rows.append(row)
         return rows
 
@@ -1555,10 +1604,15 @@ class ProcFleet:
             arts = m.artifacts or {}
             t = arts.get("trace")
             p = arts.get("provenance")
+            c = arts.get("profile_counters")
             if t and os.path.exists(t):
                 traces.append(t)
             if p and os.path.exists(p):
                 provs.append(p)
+            # Profiler counter tracks are trace-shaped files; they merge
+            # through the same path onto the child's process row.
+            if c and os.path.exists(c):
+                traces.append(c)
         if not traces and not provs:
             return None
         return merge_traces(traces, provs, path=path)

@@ -30,6 +30,7 @@ from .ledger import (
     replay_baseline,
 )
 from .merge import follow, frame_flows, merge_traces
+from .profiler import HostProfiler, null_profiler
 from .prom import export_prometheus
 from .provenance import ProvenanceLog, SidecarSocket, flow_key
 from .recorder import FlightRecorder, FrameRecord
@@ -49,6 +50,7 @@ __all__ = [
     "DesyncForensics",
     "FlightRecorder",
     "FrameRecord",
+    "HostProfiler",
     "MetricWindow",
     "P2Quantile",
     "ProvenanceLog",
@@ -69,6 +71,7 @@ __all__ = [
     "frame_flows",
     "merge_traces",
     "null_ledger",
+    "null_profiler",
     "null_timeseries",
     "null_tracer",
     "profile_window",

@@ -115,6 +115,8 @@ class AttributionProbe:
         self,
         lanes: int = 1,
         serial_device_ms: Optional[float] = None,
+        min_activity_ms: float = 0.01,
+        cost: Optional[Dict[str, float]] = None,
     ) -> Dict[str, object]:
         """The breakdown + verdict for one bench row.
 
@@ -124,6 +126,19 @@ class AttributionProbe:
         device wait is close to ``lanes ×`` the serial wait, the backend
         ran the lanes serially and the verdict says so (that row's
         ceiling is the backend, not the host).
+
+        ``min_activity_ms`` is the idle floor: when the per-dispatch
+        host+device total sits below it, the host/device split is noise
+        over noise and the verdict is ``idle`` — not a coin-flip
+        ``balanced`` that reads as a real finding.
+
+        ``cost`` joins the XLA cost observatory
+        (:func:`bevy_ggrs_tpu.utils.xla_cache.record_executable_cost`):
+        given ``flops``/``hbm_peak_bytes`` for the dispatched executable,
+        the row gains achieved FLOP/s over the measured device window and
+        ``hbm_peak_bytes``; ``mfu`` is emitted only when the caller has
+        declared the device's peak (``GGRS_PEAK_FLOPS`` env, FLOP/s) —
+        an MFU against an assumed peak would be fiction.
         """
         n = max(self.dispatches, 1)
         total = self.host_ms + self.device_ms
@@ -136,9 +151,12 @@ class AttributionProbe:
             "attr_dispatches": self.dispatches,
             "attr_compiles": int(delta.get("backend_compiles", 0)),
         }
+        per_dispatch_total = total / n
         verdict = "host_bound" if host_frac >= 0.6 else (
             "device_bound" if host_frac <= 0.4 else "balanced"
         )
+        if per_dispatch_total < min_activity_ms:
+            verdict = "idle"
         if serial_device_ms is not None and lanes > 1:
             per_dispatch_device = self.device_ms / n
             ratio = (
@@ -151,4 +169,32 @@ class AttributionProbe:
             if verdict == "device_bound" and ratio >= 0.5 * lanes:
                 verdict = "lane_serialized"
         out["attr_verdict"] = verdict
+        if cost:
+            device_s = (self.device_ms / n) / 1000.0
+            flops = float(cost.get("flops", 0.0) or 0.0)
+            if flops > 0.0 and device_s > 0.0:
+                achieved = flops / device_s
+                out["achieved_flops_per_s"] = round(achieved, 1)
+                peak = _declared_peak_flops()
+                if peak:
+                    out["mfu"] = round(achieved / peak, 5)
+            if cost.get("hbm_peak_bytes"):
+                out["hbm_peak_bytes"] = int(cost["hbm_peak_bytes"])
+            if cost.get("bytes_accessed"):
+                out["attr_bytes_accessed"] = int(cost["bytes_accessed"])
         return out
+
+
+def _declared_peak_flops() -> Optional[float]:
+    """The device's peak FLOP/s, only if the operator declared it
+    (``GGRS_PEAK_FLOPS``, plain float, e.g. ``1.97e14`` for a v4 chip).
+    No built-in device table: an undeclared peak yields no ``mfu``
+    column rather than a number computed against a guess."""
+    import os
+
+    raw = os.environ.get("GGRS_PEAK_FLOPS", "")
+    try:
+        peak = float(raw)
+    except ValueError:
+        return None
+    return peak if peak > 0 else None

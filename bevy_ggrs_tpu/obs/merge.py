@@ -9,6 +9,11 @@ single Chrome trace:
 
 - span events are copied through with process identity preserved (pid
   collisions between files are remapped, ``process_name`` metadata kept);
+- host-profiler exports (:meth:`~bevy_ggrs_tpu.obs.profiler.
+  HostProfiler.export_perfetto` — ``ph:"C"`` counter samples on tid 8)
+  are the same file shape and merge through the same path: pass them as
+  additional trace files and the counter track lands on its process row,
+  wall-aligned with the span timeline via the shared ``wall_t0`` anchor;
 - every provenance record becomes a thin ``X`` slice on a dedicated
   "wire" track of its component's process;
 - records sharing a flow key are chained with Chrome flow events
@@ -298,10 +303,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         args.traces, args.provenance, path=args.out, align=args.align
     )
     n_flow = sum(1 for e in trace["traceEvents"] if e.get("cat") == "flow")
+    n_counter = sum(
+        1 for e in trace["traceEvents"] if e.get("ph") == "C"
+    )
     print(
         f"merged {len(args.traces)} trace(s) + {len(args.provenance)} "
         f"provenance log(s) -> {args.out} "
-        f"({len(trace['traceEvents'])} events, {n_flow} flow hops)"
+        f"({len(trace['traceEvents'])} events, {n_flow} flow hops, "
+        f"{n_counter} counter samples)"
     )
     return 0
 

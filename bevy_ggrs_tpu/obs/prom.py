@@ -156,6 +156,32 @@ def export_prometheus(
             lines.append(
                 f'{base}_blame_share{{player="{player}"}} {_num(share)}'
             )
+    # XLA compile observatory (utils/xla_cache.py): per-compile wall
+    # times as a ggrs_xla_compile_ms summary plus the compile/cache
+    # counters. Process-global state, so it rides along in every export
+    # once the listeners are installed; zero compiles emit nothing.
+    try:
+        from ..utils import xla_cache as _xla
+    except Exception:  # pragma: no cover - stripped builds
+        _xla = None
+    if _xla is not None:
+        cs = _xla.compile_summary()
+        if cs["count"]:
+            times = sorted(e["ms"] for e in _xla.compile_events())
+            base = f"{namespace}_xla_compile_ms"
+            type_line(base, "summary")
+            for q in (0.5, 0.95, 0.99):
+                idx = min(int(q * len(times)), len(times) - 1)
+                lines.append(
+                    f'{base}{{quantile="{q}"}} {_num(times[idx])}'
+                )
+            lines.append(f"{base}_sum {_num(cs['total_ms'])}")
+            lines.append(f"{base}_count {_num(cs['count'])}")
+            counters = _xla.compile_counters()
+            for key in ("backend_compiles", "cache_tasks", "cache_hits"):
+                name = f"{namespace}_xla_{key}_total"
+                type_line(name, "counter")
+                lines.append(f"{name} {_num(counters.get(key, 0))}")
     if recorder is not None:
         hist = recorder.rollback_histogram()
         base = f"{namespace}_rollback_depth"

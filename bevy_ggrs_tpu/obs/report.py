@@ -35,6 +35,11 @@ th { background: #f2f2f2; } td.l, th.l { text-align: left; }
 .page { background: #f8d7da; font-weight: 600; }
 .small { color: #777; font-size: .92em; }
 pre { background: #f7f7f7; padding: .6em; overflow-x: auto; }
+.flame { font: 11px/1.3 ui-monospace, monospace; margin: .6em 0; }
+.frow { display: flex; }
+.fcell { min-width: 0; }
+.fnode { border: 1px solid #fff; padding: 0 .25em; overflow: hidden;
+  white-space: nowrap; text-overflow: ellipsis; }
 """
 
 
@@ -93,7 +98,9 @@ def _slo_section(slo_snapshot: dict) -> str:
 def _fleet_section(rows) -> str:
     """Per-server fleet table: occupancy, burn, speculation quality —
     the rows :meth:`~bevy_ggrs_tpu.fleet.balancer.FleetBalancer.
-    fleet_rows` (or a ProcFleet) produces."""
+    fleet_rows` (or a ProcFleet) produces. When the cost observatory ran
+    in a child, its rows also carry XLA compile wall-time
+    (``xla_compile_ms``) and peak executable HBM (``hbm_peak_bytes``)."""
     rows = list(rows)
     if not rows:
         return "<p class='small'>no fleet members</p>"
@@ -107,6 +114,8 @@ def _fleet_section(rows) -> str:
         pages = r.get("pages", 0)
         quar = r.get("quarantined", 0)
         occ = r.get("occupancy")
+        compile_ms = r.get("xla_compile_ms")
+        hbm = r.get("hbm_peak_bytes")
         out.append([
             f"server {r.get('server_id')}",
             (state, state_cls),
@@ -118,11 +127,14 @@ def _fleet_section(rows) -> str:
             (quar, "warn" if quar else "ok"),
             r.get("spec_hit_permille", ""),
             r.get("spec_waste_permille", ""),
+            "" if compile_ms is None else f"{float(compile_ms):.0f}",
+            "" if hbm is None else f"{float(hbm) / 1e6:.1f}",
             "" if r.get("score") is None else f"{r['score']:.3f}",
         ])
     return _table(
         ["server", "state", "matches", "active", "free", "occupancy",
-         "pages", "quarantined", "spec hit ‰", "spec waste ‰", "score"],
+         "pages", "quarantined", "spec hit ‰", "spec waste ‰",
+         "compile ms", "hbm MB", "score"],
         out,
     )
 
@@ -371,6 +383,86 @@ def _sdc_section(metrics) -> str:
     return "".join(parts)
 
 
+def _flame_hue(name: str) -> int:
+    return sum(ord(c) for c in name) * 37 % 360
+
+
+def _flame_node(node, root_ms: float, depth: int = 0) -> str:
+    """One icicle level: the node's box, then a flex row of children
+    sized by their share of the node. Pure HTML/CSS — the report stays
+    loadable from an artifact zip with no external JS."""
+    ms = float(node.get("ms", 0.0))
+    if ms <= 0.0 or depth > 16:
+        return ""
+    label = f"{node.get('name', '?')} {ms:.1f}ms"
+    h = _flame_hue(str(node.get("name", "")))
+    parts = [
+        f"<div class='fnode' style='background:hsl({h},60%,85%)' "
+        f"title='{_esc(label)}'>{_esc(label)}</div>"
+    ]
+    kids = [
+        c for c in node.get("children", ())
+        # skip slivers under 0.15% of the whole profile: unreadable at
+        # any width and they blow up the document size
+        if root_ms > 0 and 100.0 * float(c.get("ms", 0.0)) / root_ms >= 0.15
+    ]
+    if kids:
+        cells = []
+        for c in kids:
+            w = 100.0 * float(c.get("ms", 0.0)) / ms
+            cells.append(
+                f"<div class='fcell' style='width:{w:.2f}%'>"
+                + _flame_node(c, root_ms, depth + 1)
+                + "</div>"
+            )
+        parts.append("<div class='frow'>" + "".join(cells) + "</div>")
+    return "".join(parts)
+
+
+def _profile_section(profile) -> str:
+    """Host-profiler section (obs/profiler.py): sample header, per-stage
+    self-time culprit tables, and a self-contained CSS flame graph over
+    the stage -> frame-path tree."""
+    prof = profile.report() if hasattr(profile, "report") else dict(profile)
+    if not prof or not prof.get("samples"):
+        return "<p class='small'>no profile samples</p>"
+    parts = [
+        "<p class='small'>"
+        f"samples={prof.get('samples', 0)} "
+        f"profiled={_fmt(prof.get('total_ms', 0.0))}ms "
+        f"interval={_fmt(prof.get('interval_ms', 0.0))}ms "
+        f"seed={prof.get('seed', '')} "
+        f"attributed={100.0 * float(prof.get('attributed_frac', 0.0)):.1f}%"
+        "</p>"
+    ]
+    stages = prof.get("stages", {})
+    if stages:
+        rows = []
+        for stage, st in sorted(
+            stages.items(), key=lambda kv: -float(kv[1].get("total_ms", 0))
+        ):
+            top = st.get("top") or [
+                [f, m] for f, m in st.get("self_ms", {}).items()
+            ]
+            culprits = "; ".join(
+                f"{frame} {float(ms):.1f}ms" for frame, ms in top[:5]
+            )
+            rows.append([stage, f"{float(st.get('total_ms', 0.0)):.1f}",
+                         culprits])
+        parts.append(
+            _table(["stage", "self ms", "top frames (self-time)"], rows,
+                   left=1)
+        )
+    tree = prof.get("tree")
+    if tree and tree.get("ms"):
+        parts.append(
+            "<div class='flame'>"
+            + _flame_node(tree, float(tree["ms"]))
+            + "</div>"
+        )
+    return "".join(parts)
+
+
 def _metrics_section(metrics) -> str:
     summ = metrics.summary() if hasattr(metrics, "summary") else dict(metrics)
     if not summ:
@@ -395,6 +487,7 @@ def build_report(
     ledger=None,
     fleet=None,
     relay_tree=None,
+    profile=None,
     notes: Optional[str] = None,
 ) -> str:
     """Render the report; write it to ``path`` when given. ``slo`` is a
@@ -407,7 +500,10 @@ def build_report(
     ``summary()`` dict; ``fleet`` is a list of per-server row dicts
     (:meth:`~bevy_ggrs_tpu.fleet.balancer.FleetBalancer.fleet_rows`);
     ``relay_tree`` is a list of per-relay row dicts
-    (:meth:`~bevy_ggrs_tpu.relay.tree.RelayTree.topology_rows`)."""
+    (:meth:`~bevy_ggrs_tpu.relay.tree.RelayTree.topology_rows`);
+    ``profile`` is a :class:`~bevy_ggrs_tpu.obs.profiler.HostProfiler`
+    or its ``report()`` dict (rendered as per-stage culprit tables plus
+    a pure-CSS flame graph — no external JS)."""
     sections = []
     if notes:
         sections.append(f"<p>{_esc(notes)}</p>")
@@ -433,6 +529,10 @@ def build_report(
     if ledger is not None:
         sections.append(
             "<h2>Speculation ledger</h2>" + _ledger_section(ledger)
+        )
+    if profile is not None:
+        sections.append(
+            "<h2>Host profile (flame)</h2>" + _profile_section(profile)
         )
     if metrics is not None:
         sdc = _sdc_section(metrics)

@@ -34,27 +34,95 @@ from __future__ import annotations
 
 import collections
 import json
+import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 # Event tuples: ("B", name, ts_us, args) / ("E", name, ts_us, None)
 #             / ("I", name, ts_us, args)   (instant)
 
 
+# -- cross-thread span-stack registry -----------------------------------
+#
+# The sampling profiler (obs/profiler.py) runs on its OWN thread and must
+# answer "which obs span is open on the *sampled* thread right now?" — a
+# plain threading.local can't be read from outside, so the per-thread
+# stacks live in a module dict keyed by thread ident. Mutation is only
+# ever by the owning thread (append/pop under the GIL); the sampler takes
+# a snapshot with tuple(), which cannot interleave with a list mutation
+# in CPython. Entries are tokens rather than bare names so a span that
+# closes out of LIFO order (the admission path's ``first_frame`` opens at
+# enqueue and closes a later frame, overlapping everything between) is
+# removed by identity instead of corrupting its neighbours.
+
+_SPAN_STACKS: Dict[int, List["_StackToken"]] = {}
+_STACKS_LOCK = threading.Lock()  # guards registry insertion only
+
+
+class _StackToken:
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+
+def _stack_for(ident: Optional[int] = None) -> List["_StackToken"]:
+    ident = threading.get_ident() if ident is None else ident
+    stack = _SPAN_STACKS.get(ident)
+    if stack is None:
+        with _STACKS_LOCK:
+            stack = _SPAN_STACKS.setdefault(ident, [])
+    return stack
+
+
+def push_span(name: str) -> _StackToken:
+    """Mark ``name`` as the innermost open span on the calling thread.
+    Returns a token for :func:`pop_span`."""
+    tok = _StackToken(name)
+    _stack_for().append(tok)
+    return tok
+
+
+def pop_span(token: _StackToken) -> None:
+    """Close a span marker. Tolerates non-LIFO closes (removal by token
+    identity) and double-pops (a missing token is a no-op)."""
+    stack = _SPAN_STACKS.get(threading.get_ident())
+    if not stack:
+        return
+    if stack[-1] is token:
+        stack.pop()
+        return
+    try:
+        stack.remove(token)
+    except ValueError:
+        pass
+
+
+def open_span_stack(thread_ident: int) -> Tuple[str, ...]:
+    """Snapshot of the open-span names on ``thread_ident``, outermost
+    first. Safe to call from any thread (this is the profiler's read)."""
+    stack = _SPAN_STACKS.get(thread_ident)
+    if not stack:
+        return ()
+    return tuple(tok.name for tok in tuple(stack))
+
+
 class _Span:
-    __slots__ = ("_tr", "_name", "_args", "_t0")
+    __slots__ = ("_tr", "_name", "_args", "_t0", "_tok")
 
     def __init__(self, tracer: "SpanTracer", name: str, args):
         self._tr = tracer
         self._name = name
         self._args = args
         self._t0 = 0
+        self._tok = None
 
     def __enter__(self):
         tr = self._tr
         self._t0 = tr._now_us()
         tr._events.append(("B", self._name, self._t0, self._args))
         tr._depth += 1
+        self._tok = push_span(self._name)
         return self
 
     def __exit__(self, *exc):
@@ -62,6 +130,9 @@ class _Span:
         end = tr._now_us()
         tr._events.append(("E", self._name, end, None))
         tr._depth -= 1
+        if self._tok is not None:
+            pop_span(self._tok)
+            self._tok = None
         dur = (end - self._t0) / 1000.0
         agg = tr._agg.get(self._name)
         if agg is None:

@@ -33,7 +33,7 @@ from contextlib import contextmanager
 from typing import Dict, Optional
 
 from bevy_ggrs_tpu.obs.provenance import flow_key
-from bevy_ggrs_tpu.obs.trace import null_tracer
+from bevy_ggrs_tpu.obs.trace import null_tracer, pop_span, push_span
 
 #: Ordered stage names; ``durations`` holds a subset until ``complete``.
 STAGES = ("matchmake", "place", "slot_warm", "admit", "first_frame")
@@ -68,7 +68,7 @@ class AdmissionTrace:
         self.tracer = tracer if tracer is not None else null_tracer
         self._clock = clock
         self.durations: Dict[str, float] = {}
-        self._open: Dict[str, float] = {}
+        self._open: Dict[str, tuple] = {}  # stage -> (t0, span token)
         self.t_start = clock()
         self.t_done: Optional[float] = None
         self.server_id: Optional[int] = None
@@ -77,10 +77,15 @@ class AdmissionTrace:
     # -- recording -------------------------------------------------------
 
     def begin(self, stage: str) -> None:
-        self._open[stage] = self._clock()
+        # Mark the stage on the caller thread's span stack so the
+        # sampling profiler folds host samples into it. Tokens tolerate
+        # non-LIFO closes — ``first_frame`` opens at enqueue and closes
+        # frames later, overlapping every stage in between.
+        self._open[stage] = (self._clock(), push_span(f"admission_{stage}"))
 
     def end(self, stage: str) -> float:
-        t0 = self._open.pop(stage)
+        t0, tok = self._open.pop(stage)
+        pop_span(tok)
         ms = (self._clock() - t0) * 1000.0
         self.record(stage, ms)
         return ms

@@ -53,6 +53,7 @@ logic to drift.
 from __future__ import annotations
 
 import functools
+import os
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -63,6 +64,7 @@ import numpy as np
 from bevy_ggrs_tpu.fused import FusedTickExecutor, _i32_cached
 from bevy_ggrs_tpu.native import spec as native_spec
 from bevy_ggrs_tpu.obs.ledger import blame_divergence
+from bevy_ggrs_tpu.obs.trace import pop_span, push_span
 from bevy_ggrs_tpu.parallel.speculate import match_branch
 from bevy_ggrs_tpu.predict.batch import BatchedRanker
 from bevy_ggrs_tpu.predict.model import resolve_predictor
@@ -103,6 +105,26 @@ class BatchedTickExecutor:
         self._fn = jax.jit(jax.vmap(tick, in_axes=(0,) * 19 + (None,)))
         self._admit = jax.jit(self._admit_impl)
         self._spec_status = None
+        # Cost-observatory hook: when armed, the NEXT dispatch prices the
+        # compiled program (cost_analysis/memory_analysis) into
+        # utils.xla_cache under this name. Arm it before warmup — the AOT
+        # lowering's backend compile is then a cache hit of the warmup
+        # compile and lands before any churn counter is snapshotted.
+        self._cost_name: Optional[str] = None
+        self._captured_name: Optional[str] = None
+
+    def enable_cost_capture(self, name: str) -> None:
+        self._cost_name = str(name)
+
+    def cost(self) -> dict:
+        """The captured cost record for this executable ({} until a
+        dispatch ran with capture armed, or when the backend exposes no
+        cost/memory analysis)."""
+        if self._captured_name is None:
+            return {}
+        from bevy_ggrs_tpu.utils import xla_cache
+
+        return xla_cache.executable_costs().get(self._captured_name, {})
 
     @staticmethod
     def _admit_impl(rings, states, slot, new_ring, new_state):
@@ -144,13 +166,20 @@ class BatchedTickExecutor:
             self._spec_status = jnp.full(
                 (self.spec_frames, P), PREDICTED, dtype=jnp.int32
             )
-        return self._fn(
+        full_args = (
             rings, states, prev_rings, prev_states,
             branch, absorb_first, absorb_n, prev_anchor, prev_total,
             do_load, load_frame, start_frame,
             bits, status, save_mask, adv_mask,
             spec_from_live, spec_anchor, branch_bits, self._spec_status,
         )
+        if self._cost_name is not None:
+            from bevy_ggrs_tpu.utils import xla_cache
+
+            name, self._cost_name = self._cost_name, None
+            xla_cache.record_executable_cost(name, self._fn, *full_args)
+            self._captured_name = name
+        return self._fn(*full_args)
 
 
 class _SlotSpecShim:
@@ -292,6 +321,17 @@ class BatchedSessionCore:
                 self.num_branches, self.spec_frames,
             )
         S, B, F = self.num_slots, self.num_branches, self.spec_frames
+        # Cost observatory opt-in (GGRS_XLA_COST=1): the warmup dispatch
+        # prices the batched tick (flops / bytes / hbm_peak_bytes) into
+        # utils.xla_cache. Opt-in because the AOT lowering re-traces the
+        # program — its backend compile is a persistent-cache hit, but
+        # the trace itself costs seconds at large S.
+        if os.environ.get("GGRS_XLA_COST", "").lower() not in (
+            "", "0", "false"
+        ):
+            self._exec.enable_cost_capture(
+                f"batched_tick_S{S}_B{B}_F{F}"
+            )
         self._template = jax.tree_util.tree_map(jnp.asarray, initial_state)
         bcast = lambda prefix: jax.tree_util.tree_map(
             lambda x: jnp.broadcast_to(
@@ -650,6 +690,12 @@ class BatchedSessionCore:
 
         measure = self._measure_host
         t_loop = time.perf_counter() if measure else 0.0
+        # Span-stack marker for the sampling profiler: everything in the
+        # per-slot loop folds under serve_arg_assembly unless a nested
+        # marker (branch build / predictor rank) claims it — mirroring
+        # exactly how arg_ms is computed below. Armed only alongside the
+        # clock reads so the telemetry-off path stays untouched.
+        tok_loop = push_span("serve_arg_assembly") if measure else None
         bb_ms = 0.0
         rank_ms = 0.0
         # Pass 1 — as-used log writes + anchor geometry for every batched
@@ -674,6 +720,9 @@ class BatchedSessionCore:
             eligible = [i for i in batch if geom[i][3]]
             if eligible:
                 t_rank = time.perf_counter()
+                tok_rank = (
+                    push_span("serve_predictor_rank") if measure else None
+                )
                 W = self._predictor.weights.window
                 wins = np.full((S, W, P), -1, dtype=np.int32)
                 anchors = np.zeros(S, dtype=np.int32)
@@ -687,6 +736,8 @@ class BatchedSessionCore:
                     seeds[i] = self._predictor.render_seed(
                         traj_idx[i], order[i]
                     )
+                if tok_rank is not None:
+                    pop_span(tok_rank)
                 rank_ms = (time.perf_counter() - t_rank) * 1000.0
                 self.last_predictor_rank_ms = rank_ms
                 self.predictor_rank_ms_total += rank_ms
@@ -774,9 +825,11 @@ class BatchedSessionCore:
             if spec_active:
                 if measure:
                     t_bb = time.perf_counter()
+                    tok_bb = push_span("serve_branch_build")
                     bb = self._build_branches(
                         s, anchor, end, session, seeds.get(i)
                     )
+                    pop_span(tok_bb)
                     bb_ms += (time.perf_counter() - t_bb) * 1000.0
                 else:
                     bb = self._build_branches(
@@ -819,6 +872,8 @@ class BatchedSessionCore:
                 n_tail, session, missed, blame_player, blame_frame,
             )
 
+        if tok_loop is not None:
+            pop_span(tok_loop)
         if measure:
             # Everything in the loop that is not the branch build is
             # argument assembly (log writes, match, per-slot array fills).

@@ -133,8 +133,10 @@ class MatchServer:
         admission_slo_ms: Optional[float] = None,
         ledger=None,
         attest_interval: Optional[int] = 64,
+        profiler=None,
     ):
         from bevy_ggrs_tpu.obs.ledger import null_ledger
+        from bevy_ggrs_tpu.obs.profiler import null_profiler
         from bevy_ggrs_tpu.obs.slo import SlotSLO, WindowSLO
         from bevy_ggrs_tpu.obs.timeseries import null_timeseries
         from bevy_ggrs_tpu.obs.trace import null_tracer
@@ -148,6 +150,11 @@ class MatchServer:
         install_compile_listeners()
         self.metrics = metrics if metrics is not None else null_metrics
         self.tracer = tracer if tracer is not None else null_tracer
+        # Sampling host profiler (obs/profiler.py): reads the serving
+        # thread's stacks from its own thread — wire-inert by
+        # construction. The server does not start/stop it (the soak
+        # harness owns the window); it only exports its artifacts.
+        self.profiler = profiler if profiler is not None else null_profiler
         self.timeseries = (
             timeseries if timeseries is not None else null_timeseries
         )
@@ -1166,6 +1173,16 @@ class MatchServer:
             p = _os.path.join(directory, f"{prefix}_trace.json")
             self.tracer.export_perfetto(p)
             out["trace"] = p
+        if getattr(self.profiler, "enabled", False):
+            p = _os.path.join(directory, f"{prefix}_profile.folded")
+            self.profiler.export_folded(p)
+            out["profile_folded"] = p
+            p = _os.path.join(directory, f"{prefix}_profile_counters.json")
+            self.profiler.export_perfetto(p)
+            out["profile_counters"] = p
+            p = _os.path.join(directory, f"{prefix}_profile.json")
+            self.profiler.export_report_json(p)
+            out["profile"] = p
         p = _os.path.join(directory, f"{prefix}_metrics.prom")
         export_prometheus(
             self.metrics,
@@ -1200,6 +1217,10 @@ class MatchServer:
                 self.timeseries if self.timeseries.enabled else None
             ),
             ledger=self.ledger if self.ledger.enabled else None,
+            profile=(
+                self.profiler
+                if getattr(self.profiler, "enabled", False) else None
+            ),
             notes=(
                 f"frames_served={self.frames_served} "
                 f"faults={self.faults_total} "

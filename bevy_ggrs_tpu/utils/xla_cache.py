@@ -19,7 +19,7 @@ function is a no-op when one is already set.
 from __future__ import annotations
 
 import os
-from typing import Optional
+from typing import Dict, List, Optional
 
 _DEFAULT_DIR = "/tmp/bevy_ggrs_tpu_jax_cache"
 
@@ -72,6 +72,12 @@ _COUNTERS = {
     "cache_tasks": 0,
     "cache_hits": 0,
 }
+# One record per actual backend compile: {"ms": wall_ms, "fingerprint":
+# whatever identity the monitoring event carried (module name/fingerprint
+# kwarg; "" when the jax version passes none)}. This is the decomposition
+# of cold-start cost the autoscale rows need — scale_up_latency p50≈13.5s
+# is a child JAX boot, and this says how much of it was XLA compiling.
+_COMPILE_EVENTS: List[dict] = []
 _LISTENERS_INSTALLED = False
 
 
@@ -105,6 +111,14 @@ def install_compile_listeners() -> bool:
             # actual backend (XLA) compile — cache hits don't emit it.
             if event.endswith("backend_compile_duration"):
                 _COUNTERS["backend_compiles"] += 1
+                fp = ""
+                for key in ("fingerprint", "module_name", "module_id"):
+                    if kwargs.get(key):
+                        fp = str(kwargs[key])
+                        break
+                _COMPILE_EVENTS.append(
+                    {"ms": float(duration) * 1000.0, "fingerprint": fp}
+                )
 
         monitoring.register_event_listener(_on_event)
         monitoring.register_event_duration_secs_listener(_on_duration)
@@ -121,3 +135,108 @@ def compile_counters() -> dict:
     only events after installation are counted — snapshot a baseline and
     compare deltas)."""
     return dict(_COUNTERS)
+
+
+def compile_events() -> List[dict]:
+    """Per-compile wall-time records (copies), in occurrence order."""
+    return [dict(e) for e in _COMPILE_EVENTS]
+
+
+def compile_summary() -> dict:
+    """Aggregate of the per-compile wall times: the
+    ``ggrs_xla_compile_ms`` summary obs/prom.py exports and the
+    compile-cost column autoscale rows carry. Empty-safe (all zeros
+    before the first post-installation compile)."""
+    times = sorted(e["ms"] for e in _COMPILE_EVENTS)
+    if not times:
+        return {
+            "count": 0,
+            "total_ms": 0.0,
+            "mean_ms": 0.0,
+            "p50_ms": 0.0,
+            "max_ms": 0.0,
+            "fingerprints": [],
+        }
+    total = float(sum(times))
+    return {
+        "count": len(times),
+        "total_ms": round(total, 3),
+        "mean_ms": round(total / len(times), 3),
+        "p50_ms": round(times[len(times) // 2], 3),
+        "max_ms": round(times[-1], 3),
+        "fingerprints": sorted(
+            {e["fingerprint"] for e in _COMPILE_EVENTS if e["fingerprint"]}
+        ),
+    }
+
+
+# -- per-executable cost/memory analysis --------------------------------
+#
+# The monitoring listeners see durations, never executables, so the cost
+# observatory is an explicit capture: callers that own a jitted function
+# (executor warmup, the bench harness) register it once under a stable
+# name and this module prices it via the AOT path —
+# ``jitted.lower(*args).compile()`` then ``cost_analysis()`` (flops,
+# bytes accessed) and ``memory_analysis()`` (argument/output/temp/
+# generated-code bytes, summed into ``hbm_peak_bytes``: the number that
+# decides how many lanes fit a device). The AOT compile re-traces, but
+# its backend compile is a persistent-cache hit of the HLO the live jit
+# call already compiled — call it during warmup, before any compile
+# counters are snapshotted for churn gates.
+
+_EXEC_COSTS: Dict[str, dict] = {}
+
+_MEMORY_FIELDS = (
+    ("argument_size_in_bytes", "argument_bytes"),
+    ("output_size_in_bytes", "output_bytes"),
+    ("temp_size_in_bytes", "temp_bytes"),
+    ("generated_code_size_in_bytes", "generated_code_bytes"),
+)
+
+
+def record_executable_cost(name: str, jitted, *args, **kwargs) -> dict:
+    """Price ``jitted`` (a ``jax.jit`` callable) for call args once under
+    ``name``; later calls with the same name return the cached record.
+    Exception-safe: any backend that lacks cost/memory analysis yields
+    ``{}`` — the observatory degrades to absent columns, never a crash.
+    """
+    if name in _EXEC_COSTS:
+        return dict(_EXEC_COSTS[name])
+    out: Dict[str, float] = {}
+    try:
+        compiled = jitted.lower(*args, **kwargs).compile()
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            if ca:
+                if "flops" in ca:
+                    out["flops"] = float(ca["flops"])
+                if "bytes accessed" in ca:
+                    out["bytes_accessed"] = float(ca["bytes accessed"])
+        except Exception:
+            pass
+        try:
+            ma = compiled.memory_analysis()
+            if ma is not None:
+                hbm = 0.0
+                seen = False
+                for attr, key in _MEMORY_FIELDS:
+                    v = getattr(ma, attr, None)
+                    if v is not None:
+                        out[key] = float(v)
+                        hbm += float(v)
+                        seen = True
+                if seen:
+                    out["hbm_peak_bytes"] = hbm
+        except Exception:
+            pass
+    except Exception:
+        out = {}
+    _EXEC_COSTS[name] = out
+    return dict(out)
+
+
+def executable_costs() -> Dict[str, dict]:
+    """Snapshot of every priced executable: name -> cost record."""
+    return {k: dict(v) for k, v in _EXEC_COSTS.items()}

@@ -1,0 +1,202 @@
+"""Regression attribution in the bench gate (tools/bench_gate.py).
+
+When a latency check trips and both the fresh row and the committed
+baseline carry the compact host-profile blob
+(``HostProfiler.profile_blob()``), the gate must *name the frame*: the
+stack frame whose self-time share of its stage grew most. Both
+directions are pinned — an injected slowdown is blamed on the right
+frame, and a clean pass (or a sub-threshold wiggle) stays silent.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools"),
+)
+import bench_gate  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def blob(stage_frames):
+    """{stage: {frame: self_ms}} -> profile_blob shape."""
+    stages = {}
+    for stage, frames in stage_frames.items():
+        stages[stage] = {
+            "total_ms": round(sum(frames.values()), 3),
+            "self_ms": dict(frames),
+        }
+    return {
+        "samples": 100,
+        "total_ms": round(
+            sum(s["total_ms"] for s in stages.values()), 3
+        ),
+        "attributed_frac": 1.0,
+        "stages": stages,
+    }
+
+
+def row(value, profile=None, metric="front_door_S4"):
+    r = {
+        "metric": metric, "value": value, "unit": "ms",
+        "platform": "cpu", "frames": 100, "num_branches": 8,
+        # front_door health columns the gate requires:
+        "desyncs": 0, "churn_recompiles": 0,
+        "knee_admissions_per_sec": 3.0, "admission_p50_ms": 1.0,
+        "admission_p99_ms": value, "stage_place_p99_ms": 0.1,
+        "stage_slot_warm_p99_ms": 0.2, "stage_admit_p99_ms": 0.3,
+        "stage_first_frame_p99_ms": 0.4, "branch_build_p99_ms": 0.1,
+        "arg_assembly_p99_ms": 0.1,
+    }
+    if profile is not None:
+        r["profile"] = profile
+    return r
+
+
+BASE_PROFILE = blob({
+    "admission_admit": {
+        "admit (batch.py)": 40.0, "checksum (state.py)": 10.0,
+    },
+    "admission_slot_warm": {"build (supervisor.py)": 25.0},
+})
+
+# Same run shape, but `checksum (state.py)` ballooned from a 20% share
+# of its stage to 80% — the injected regression the gate must name.
+SLOW_PROFILE = blob({
+    "admission_admit": {
+        "admit (batch.py)": 40.0, "checksum (state.py)": 160.0,
+    },
+    "admission_slot_warm": {"build (supervisor.py)": 50.0},
+})
+
+
+class TestAttributeRegression:
+    def test_names_the_grown_frame(self):
+        msg = bench_gate.attribute_regression(
+            row(5.0, SLOW_PROFILE), row(1.0, BASE_PROFILE)
+        )
+        assert msg is not None
+        assert "checksum (state.py)" in msg
+        assert "admission_admit" in msg
+        assert "20.0% -> 80.0%" in msg
+
+    def test_brand_new_frame_counts_from_zero_share(self):
+        cur = blob({"admission_admit": {
+            "admit (batch.py)": 40.0, "surprise (new.py)": 60.0,
+        }})
+        base = blob({"admission_admit": {"admit (batch.py)": 40.0}})
+        msg = bench_gate.attribute_regression(
+            row(5.0, cur), row(1.0, base)
+        )
+        assert "surprise (new.py)" in msg
+        assert "0.0% -> 60.0%" in msg
+
+    def test_identical_profiles_stay_silent(self):
+        assert bench_gate.attribute_regression(
+            row(5.0, BASE_PROFILE), row(1.0, BASE_PROFILE)
+        ) is None
+
+    def test_sub_threshold_wiggle_stays_silent(self):
+        wig = blob({"admission_admit": {
+            "admit (batch.py)": 39.5, "checksum (state.py)": 10.5,
+        }})
+        assert bench_gate.attribute_regression(
+            row(5.0, wig), row(1.0, BASE_PROFILE)
+        ) is None
+
+    def test_missing_blob_either_side_stays_silent(self):
+        assert bench_gate.attribute_regression(
+            row(5.0, SLOW_PROFILE), row(1.0)
+        ) is None
+        assert bench_gate.attribute_regression(
+            row(5.0), row(1.0, BASE_PROFILE)
+        ) is None
+        assert bench_gate.attribute_regression(row(5.0), None) is None
+
+    def test_malformed_blob_degrades_silently(self):
+        assert bench_gate.attribute_regression(
+            row(5.0, {"stages": {"s": {"total_ms": "nan?",
+                                       "self_ms": {"f": "x"}}}}),
+            row(1.0, BASE_PROFILE),
+        ) is None
+
+    def test_share_normalization_cancels_run_length(self):
+        # 10x the run, identical shape: shares are equal, no blame.
+        scaled = blob({
+            stage: {f: ms * 10.0 for f, ms in per["self_ms"].items()}
+            for stage, per in BASE_PROFILE["stages"].items()
+        })
+        assert bench_gate.attribute_regression(
+            row(5.0, scaled), row(1.0, BASE_PROFILE)
+        ) is None
+
+
+class TestCheckRowIntegration:
+    def test_fail_detail_carries_the_blame(self):
+        v = bench_gate.check_row(
+            row(5.0, SLOW_PROFILE), row(1.0, BASE_PROFILE),
+            rel_tol=0.35, abs_tol=0.05,
+        )
+        assert v["status"] == "FAIL"
+        assert "profile blames" in v["detail"]
+        assert "checksum (state.py)" in v["detail"]
+
+    def test_clean_pass_has_no_blame_line(self):
+        v = bench_gate.check_row(
+            row(1.0, SLOW_PROFILE), row(1.0, BASE_PROFILE),
+            rel_tol=0.35, abs_tol=0.05,
+        )
+        assert v["status"] == "ok"
+        assert "blames" not in v["detail"]
+
+    def test_fail_without_blobs_still_fails_plainly(self):
+        v = bench_gate.check_row(
+            row(5.0), row(1.0), rel_tol=0.35, abs_tol=0.05
+        )
+        assert v["status"] == "FAIL"
+        assert "blames" not in v["detail"]
+
+
+@pytest.mark.slow
+class TestGateCli:
+    def test_cli_end_to_end_blames_and_exits_1(self, tmp_path):
+        cur = tmp_path / "cur.json"
+        base = tmp_path / "base.json"
+        cur.write_text(json.dumps(row(5.0, SLOW_PROFILE)))
+        base.write_text(json.dumps(row(1.0, BASE_PROFILE)))
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO_ROOT, "tools", "bench_gate.py"),
+                str(cur), "--baseline", str(base),
+                "--report", str(tmp_path / "gate.html"),
+            ],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 1
+        assert "profile blames" in proc.stdout
+        assert "checksum (state.py)" in proc.stdout
+        html = (tmp_path / "gate.html").read_text()
+        assert "checksum (state.py)" in html
+
+    def test_cli_clean_run_exits_0_silent(self, tmp_path):
+        cur = tmp_path / "cur.json"
+        base = tmp_path / "base.json"
+        cur.write_text(json.dumps(row(1.0, SLOW_PROFILE)))
+        base.write_text(json.dumps(row(1.0, BASE_PROFILE)))
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO_ROOT, "tools", "bench_gate.py"),
+                str(cur), "--baseline", str(base),
+            ],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0
+        assert "blames" not in proc.stdout

@@ -1,0 +1,461 @@
+"""Span-aware sampling host profiler (obs/profiler.py) and the
+cross-thread span-stack registry it samples (obs/trace.py).
+
+The deterministic core is ``sample_once(now, frames, span_stack)`` —
+tests inject stacks and clocks so folding, weighting, attribution math,
+and export shapes are exact assertions, not statistical ones. A small
+live-thread section proves the background sampler actually reads a real
+thread's frames and the spans the admission trace / tracer push.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from bevy_ggrs_tpu.obs.profiler import (
+    NO_SPAN,
+    UNATTRIBUTED,
+    HostProfiler,
+    null_profiler,
+)
+from bevy_ggrs_tpu.obs.trace import (
+    SpanTracer,
+    open_span_stack,
+    pop_span,
+    push_span,
+)
+from bevy_ggrs_tpu.serve.admission import AdmissionTrace
+
+
+# ---------------------------------------------------------------------------
+# span-stack registry
+
+
+def _opened_since(ident, base):
+    """Spans this test opened, ignoring tokens earlier tests abandoned.
+
+    Servers torn down mid-flight leave their ``admission_first_frame``
+    tokens on this thread's stack; new pushes append after them, so the
+    suffix past the baseline snapshot is exactly ours.
+    """
+    return open_span_stack(ident)[len(base):]
+
+
+class TestSpanStack:
+    def test_push_pop_lifo(self):
+        ident = threading.get_ident()
+        base = open_span_stack(ident)
+        a = push_span("outer")
+        b = push_span("inner")
+        assert _opened_since(ident, base) == ("outer", "inner")
+        pop_span(b)
+        assert _opened_since(ident, base) == ("outer",)
+        pop_span(a)
+        assert _opened_since(ident, base) == ()
+
+    def test_non_lifo_close_removes_by_identity(self):
+        # The admission trace's first_frame span opens at enqueue and
+        # closes frames later — overlapping every stage in between.
+        ident = threading.get_ident()
+        base = open_span_stack(ident)
+        first = push_span("admission_first_frame")
+        admit = push_span("admission_admit")
+        pop_span(first)  # out of order
+        assert _opened_since(ident, base) == ("admission_admit",)
+        pop_span(admit)
+        assert _opened_since(ident, base) == ()
+
+    def test_pop_missing_token_is_noop(self):
+        ident = threading.get_ident()
+        base = open_span_stack(ident)
+        tok = push_span("x")
+        pop_span(tok)
+        pop_span(tok)  # double close must not raise or corrupt
+        assert _opened_since(ident, base) == ()
+
+    def test_unknown_thread_reads_empty(self):
+        assert open_span_stack(999_999_999) == ()
+
+    def test_tracer_spans_register(self):
+        tracer = SpanTracer()
+        ident = threading.get_ident()
+        base = open_span_stack(ident)
+        with tracer.span("tick"):
+            with tracer.span("branch_build"):
+                assert _opened_since(ident, base) == ("tick", "branch_build")
+            assert _opened_since(ident, base) == ("tick",)
+        assert _opened_since(ident, base) == ()
+
+    def test_admission_trace_stages_register(self):
+        tr = AdmissionTrace(7, clock=time.perf_counter)
+        ident = threading.get_ident()
+        base = open_span_stack(ident)
+        tr.begin("first_frame")
+        with tr.stage("admit"):
+            assert _opened_since(ident, base) == (
+                "admission_first_frame", "admission_admit",
+            )
+        tr.end("first_frame")
+        assert _opened_since(ident, base) == ()
+        assert set(tr.durations) == {"first_frame", "admit"}
+
+
+# ---------------------------------------------------------------------------
+# deterministic folding
+
+
+def fed_profiler(**kw):
+    """Profiler with a frozen clock; tests inject samples directly."""
+    return HostProfiler(interval_ms=2.0, seed=0, clock=lambda: 0.0, **kw)
+
+
+class TestFolding:
+    def test_first_sample_weighs_one_interval(self):
+        p = fed_profiler()
+        p.sample_once(now=0.0, frames=["main (x.py)"], span_stack=("s",))
+        assert p.total_ms == pytest.approx(2.0)
+
+    def test_weight_is_measured_gap_to_leaf_frame(self):
+        p = fed_profiler()
+        p.sample_once(
+            now=0.0, frames=["main (x.py)", "work (y.py)"],
+            span_stack=("tick",),
+        )
+        p.sample_once(
+            now=0.003, frames=["main (x.py)", "work (y.py)"],
+            span_stack=("tick",),
+        )
+        # 2.0 nominal + 3.0 measured, all self-time on the LEAF.
+        table = p.stage_table()
+        assert table["tick"]["total_ms"] == pytest.approx(5.0)
+        assert table["tick"]["top"][0] == ["work (y.py)", 5.0]
+
+    def test_gap_cap_bounds_a_suspended_process(self):
+        p = fed_profiler(gap_cap_ms=250.0)
+        p.sample_once(now=0.0, frames=["f (a.py)"], span_stack=("s",))
+        p.sample_once(now=60.0, frames=["f (a.py)"], span_stack=("s",))
+        assert p.total_ms == pytest.approx(2.0 + 250.0)
+
+    def test_no_open_span_folds_into_no_span_bucket(self):
+        p = fed_profiler()
+        stage = p.sample_once(
+            now=0.0, frames=["idle (a.py)"], span_stack=()
+        )
+        assert stage == NO_SPAN
+        assert NO_SPAN in p.stage_table()
+
+    def test_innermost_span_wins(self):
+        p = fed_profiler()
+        stage = p.sample_once(
+            now=0.0, frames=["f (a.py)"],
+            span_stack=("outer", "inner"),
+        )
+        assert stage == "inner"
+
+    def test_unreadable_stack_counts_unattributed(self):
+        p = fed_profiler()
+        p.sample_once(now=0.0, frames=[], span_stack=("s",))
+        p.sample_once(now=0.002, frames=["f (a.py)"], span_stack=("s",))
+        p.sample_once(now=0.004, frames=["f (a.py)"], span_stack=("s",))
+        # 2 ms nominal unattributed vs 4 ms attributed.
+        assert p.attributed_frac() == pytest.approx(4.0 / 6.0)
+        assert [UNATTRIBUTED, 2.0] in p.stage_table()["s"]["top"]
+
+    def test_attributed_frac_stage_prefix(self):
+        p = fed_profiler()
+        p.sample_once(
+            now=0.0, frames=[], span_stack=("admission_admit",)
+        )
+        p.sample_once(
+            now=0.002, frames=["f (a.py)"],
+            span_stack=("admission_admit",),
+        )
+        p.sample_once(now=0.004, frames=[], span_stack=("serve",))
+        assert p.attributed_frac("admission_") == pytest.approx(0.5)
+        # Empty selection reads as fully attributed, not 0/0 noise.
+        assert p.attributed_frac("nope_") == 1.0
+
+    def test_max_depth_truncates_keeping_leaf(self):
+        p = fed_profiler(max_depth=2)
+        p.sample_once(
+            now=0.0,
+            frames=["a (x.py)", "b (x.py)", "c (x.py)"],
+            span_stack=("s",),
+        )
+        [line] = p.folded()
+        assert line.startswith("s;b (x.py);c (x.py) ")
+
+    def test_folded_format_and_order(self):
+        p = fed_profiler()
+        for t, fr in ((0.0, "cold"), (0.002, "hot"), (0.004, "hot")):
+            p.sample_once(
+                now=t, frames=[f"{fr} (m.py)"], span_stack=("tick",)
+            )
+        lines = p.folded()
+        # Heaviest first; integer microseconds; stage;...;leaf shape.
+        assert lines[0] == "tick;hot (m.py) 4000"
+        assert lines[1] == "tick;cold (m.py) 2000"
+
+    def test_export_folded_roundtrip(self, tmp_path):
+        p = fed_profiler()
+        p.sample_once(now=0.0, frames=["f (a.py)"], span_stack=("s",))
+        path = tmp_path / "prof.folded"
+        assert p.export_folded(str(path)) == 1
+        assert path.read_text().strip() == "s;f (a.py) 2000"
+
+    def test_flame_tree_nests_and_sorts(self):
+        p = fed_profiler()
+        p.sample_once(
+            now=0.0, frames=["main (x.py)", "slow (y.py)"],
+            span_stack=("tick",),
+        )
+        p.sample_once(
+            now=0.004, frames=["main (x.py)", "slow (y.py)"],
+            span_stack=("tick",),
+        )
+        p.sample_once(
+            now=0.005, frames=["main (x.py)", "fast (y.py)"],
+            span_stack=("tick",),
+        )
+        tree = p.flame_tree()
+        assert tree["name"] == "all" and tree["ms"] == pytest.approx(7.0)
+        (tick,) = tree["children"]
+        (main,) = tick["children"]
+        assert [c["name"] for c in main["children"]] == [
+            "slow (y.py)", "fast (y.py)",
+        ]
+
+    def test_report_and_blob_shapes(self):
+        p = fed_profiler()
+        p.sample_once(now=0.0, frames=["f (a.py)"], span_stack=("s",))
+        rep = p.report()
+        for key in (
+            "samples", "total_ms", "interval_ms", "seed",
+            "attributed_frac", "unattributed_ms", "stages", "tree",
+        ):
+            assert key in rep
+        blob = p.profile_blob(top_k=1)
+        assert blob["samples"] == 1
+        assert blob["stages"]["s"]["self_ms"] == {"f (a.py)": 2.0}
+        json.dumps(blob)  # bench rows embed it — must be JSON-clean
+
+    def test_blob_top_k_truncates(self):
+        p = fed_profiler()
+        for i, t in enumerate((0.0, 0.002, 0.004)):
+            p.sample_once(
+                now=t, frames=[f"f{i} (a.py)"], span_stack=("s",)
+            )
+        blob = p.profile_blob(top_k=2)
+        assert len(blob["stages"]["s"]["self_ms"]) == 2
+
+    def test_seeded_jitter_schedule_is_deterministic(self):
+        import random
+
+        a = [random.Random(3).random() for _ in range(8)]
+        b = [random.Random(3).random() for _ in range(8)]
+        assert a == b  # the density contract start()/_run relies on
+
+
+# ---------------------------------------------------------------------------
+# perfetto counter export
+
+
+class TestPerfettoExport:
+    def test_counter_track_shape(self, tmp_path):
+        p = fed_profiler(pid=4, process_name="srv4", wall_t0=123.5)
+        p.sample_once(
+            now=0.001, frames=["a (x.py)", "b (x.py)"], span_stack=("s",)
+        )
+        path = tmp_path / "prof_counters.json"
+        trace = p.export_perfetto(str(path))
+        on_disk = json.loads(path.read_text())
+        assert on_disk == trace
+        assert trace["otherData"]["wall_t0"] == 123.5
+        counters = [
+            e for e in trace["traceEvents"] if e.get("ph") == "C"
+        ]
+        assert len(counters) == 1
+        ev = counters[0]
+        assert ev["pid"] == 4 and ev["tid"] == 8
+        assert ev["args"]["stack_depth"] == 2
+        assert ev["args"]["profiled_ms"] == pytest.approx(2.0)
+        names = [
+            e["args"]["name"] for e in trace["traceEvents"]
+            if e.get("ph") == "M"
+        ]
+        assert "srv4" in names and "host_profiler" in names
+
+    def test_merges_with_span_traces(self, tmp_path):
+        from bevy_ggrs_tpu.obs.merge import merge_traces
+
+        tracer = SpanTracer(pid=0, process_name="peer-0")
+        with tracer.span("tick"):
+            pass
+        p = fed_profiler(pid=0, process_name="peer-0")
+        p.sample_once(now=0.0, frames=["f (a.py)"], span_stack=("tick",))
+        t1 = tmp_path / "spans.json"
+        t2 = tmp_path / "prof.json"
+        tracer.export_perfetto(str(t1))
+        p.export_perfetto(str(t2))
+        merged = merge_traces(
+            [str(t1), str(t2)], path=str(tmp_path / "merged.json")
+        )
+        phs = {e.get("ph") for e in merged["traceEvents"]}
+        assert "C" in phs  # the counter track survived the merge
+
+    def test_track_capacity_bounds_memory(self):
+        p = fed_profiler(track_capacity=4)
+        for i in range(10):
+            p.sample_once(
+                now=i * 0.002, frames=["f (a.py)"], span_stack=("s",)
+            )
+        trace = p.export_perfetto()
+        counters = [
+            e for e in trace["traceEvents"] if e.get("ph") == "C"
+        ]
+        assert len(counters) == 4  # ring, not unbounded
+        assert p.samples == 10  # ...but the fold kept everything
+
+
+# ---------------------------------------------------------------------------
+# live thread
+
+
+class TestLiveSampling:
+    def test_background_sampler_reads_real_spans(self):
+        done = time.perf_counter() + 0.15
+        p = HostProfiler(interval_ms=1.0, seed=1)
+        tok = push_span("busy_loop")
+        try:
+            with p:
+                while time.perf_counter() < done:
+                    sum(i * i for i in range(200))
+        finally:
+            pop_span(tok)
+        assert p.samples > 5
+        assert "busy_loop" in p.stage_table()
+        assert p.attributed_frac() > 0.95
+
+    def test_stop_is_idempotent_and_restartable(self):
+        p = HostProfiler(interval_ms=1.0)
+        p.start()
+        p.start()  # second start is a no-op, not a second thread
+        p.stop()
+        p.stop()
+        p.start()
+        p.stop()
+
+    def test_dead_target_thread_is_unattributed_not_fatal(self):
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join()
+        p = HostProfiler(interval_ms=1.0, target_thread=t.ident)
+        p.sample_once(now=0.0, span_stack=("s",))
+        assert p.samples == 1
+        assert p.attributed_frac() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# front-door integration: real admissions, real spans, real samples
+
+
+class TestAdmissionIntegration:
+    def test_admission_stages_fold_and_export(self, tmp_path):
+        """The acceptance bar, end to end at test scale: a profiled
+        MatchServer admitting real matches folds host samples into the
+        ``admission_*`` stages with >= 95% of that self-time attributed
+        to named frames, and ``export_telemetry`` writes the folded
+        stacks, the counter trace, and a flame-bearing ops report."""
+        from tests.test_serve_faults import (
+            inputs_for, make_server, make_synctest,
+        )
+
+        prof = HostProfiler(interval_ms=0.5, seed=3)
+        srv = make_server(
+            profiler=prof, trace_dir=str(tmp_path), capacity=8
+        )
+        prof.start()
+        try:
+            for mid in range(4):
+                tr = AdmissionTrace(mid)
+                with tr.stage("matchmake"):
+                    session = make_synctest()
+                srv.enqueue_match(
+                    session, inputs_for(mid), trace=tr
+                )
+            for _ in range(30):
+                srv.run_frame()
+        finally:
+            prof.stop()
+        assert prof.samples > 0
+        stages = prof.stage_table()
+        assert any(s.startswith("admission_") for s in stages), (
+            f"no admission stage sampled; saw {sorted(stages)}"
+        )
+        # >= 95% of admission-stage self-time names a Python frame.
+        assert prof.attributed_frac("admission_") >= 0.95
+        arts = srv.export_telemetry(prefix="fd")
+        folded = (tmp_path / "fd_profile.folded").read_text()
+        assert folded.strip()  # non-empty pprof-style stacks
+        assert "profile_folded" in arts and "profile_counters" in arts
+        counters = json.loads(
+            (tmp_path / "fd_profile_counters.json").read_text()
+        )
+        assert any(
+            e.get("ph") == "C" for e in counters["traceEvents"]
+        )
+        html = (tmp_path / "fd_report.html").read_text()
+        assert "Host profile (flame)" in html
+
+
+# ---------------------------------------------------------------------------
+# null profiler
+
+
+class TestNullProfiler:
+    def test_null_profiler_is_inert(self, tmp_path):
+        n = null_profiler
+        assert n.enabled is False
+        assert n.start() is n and n.stop() is n
+        with n:
+            pass
+        assert n.sample_once() is None
+        assert n.folded() == []
+        assert n.export_folded(str(tmp_path / "x")) == 0
+        assert not (tmp_path / "x").exists()
+        assert n.stage_table() == {}
+        assert n.profile_blob() is None
+        assert n.flame_tree()["children"] == []
+        assert n.attributed_frac() == 0.0
+        assert n.export_perfetto()["traceEvents"] == []
+
+    def test_server_defaults_to_null_profiler(self):
+        from bevy_ggrs_tpu.serve.server import MatchServer
+
+        import inspect
+
+        sig = inspect.signature(MatchServer.__init__)
+        assert sig.parameters["profiler"].default is None
+
+
+# ---------------------------------------------------------------------------
+# report rendering
+
+
+class TestReportRendering:
+    def test_flame_section_renders_self_contained(self, tmp_path):
+        from bevy_ggrs_tpu.obs.report import build_report
+
+        p = fed_profiler()
+        p.sample_once(
+            now=0.0, frames=["main (x.py)", "hot (y.py)"],
+            span_stack=("admission_admit",),
+        )
+        out = tmp_path / "ops.html"
+        build_report(str(out), title="t", profile=p)
+        html = out.read_text()
+        assert "Host profile (flame)" in html
+        assert "admission_admit" in html and "hot (y.py)" in html
+        assert "<script" not in html  # self-contained: CSS only

@@ -83,6 +83,66 @@ def test_p2_constant_stream_is_exact():
     assert sk.value() == 4.25
 
 
+# Adversarial streams: the two shapes a streaming sketch classically
+# flubs — fully sorted input (every sample lands past the last marker)
+# and a constant plateau broken by a step (degenerate markers, then a
+# regime change). The envelope invariant (estimate within the stream's
+# observed [min, max]) must hold unconditionally; accuracy claims are
+# pinned only where P² actually delivers them.
+
+
+@pytest.mark.parametrize("q", [0.5, 0.95, 0.99])
+@pytest.mark.parametrize("ascending", [True, False],
+                         ids=["ascending", "descending"])
+def test_p2_sorted_stream_stays_tight(q, ascending):
+    xs = [float(i) for i in range(1, 5001)]
+    if not ascending:
+        xs.reverse()
+    sk = P2Quantile(q)
+    for x in xs:
+        sk.add(x)
+    true = float(np.percentile(xs, q * 100.0))
+    # Monotone input is P2's best case — markers glide with the stream.
+    assert abs(sk.value() - true) <= 0.001 * true, (
+        f"sorted stream: P2(q={q}) = {sk.value():.2f} vs numpy {true:.2f}"
+    )
+    assert xs[0] <= sk.value() <= xs[-1] or xs[-1] <= sk.value() <= xs[0]
+
+
+def test_p2_constant_then_step_high_quantiles_follow():
+    # 1000 samples at 1.0 (markers fully degenerate), then 1000 at
+    # 100.0: the true p95/p99 jump to the step value and the sketch
+    # must follow it there — a sketch stuck on the plateau would hide
+    # a 100x latency regression from every SLO built on it.
+    for q in (0.95, 0.99):
+        sk = P2Quantile(q)
+        for _ in range(1000):
+            sk.add(1.0)
+        assert sk.value() == 1.0  # exact while the stream is constant
+        for _ in range(1000):
+            sk.add(100.0)
+        assert abs(sk.value() - 100.0) <= 1e-6, (
+            f"P2(q={q}) = {sk.value():.4f} never reached the step"
+        )
+
+
+def test_p2_constant_then_step_median_is_bounded_not_exact():
+    # The documented weak spot: the median marker interpolates across
+    # the 1.0 -> 100.0 cliff, so p50 smears (true 50.5, estimate lands
+    # well below). Pin the CONTRACT, not the flaw's exact value: the
+    # estimate stays inside the observed envelope, and exact tail reads
+    # belong to MetricWindow.window_percentile (next test).
+    sk = P2Quantile(0.5)
+    xs = [1.0] * 1000 + [100.0] * 1000
+    for x in xs:
+        sk.add(x)
+    assert 1.0 <= sk.value() <= 100.0
+    w = MetricWindow("frame_ms", window=2000)
+    for x in xs:
+        w.observe(x)
+    assert w.window_percentile(0.5) == float(np.percentile(xs, 50.0))
+
+
 # ---------------------------------------------------------------------------
 # MetricWindow: sketches + exact ring
 # ---------------------------------------------------------------------------

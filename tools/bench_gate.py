@@ -23,6 +23,12 @@ Design constraints this encodes:
   speculation-ledger economics columns, and a ``*_spec_on*`` row with
   ``spec_full_hit_rate == 0`` fails outright: a silently dead speculation
   path used to pass on latency alone.
+- **Regression attribution.** When a latency check fails and BOTH rows
+  carry the compact host-profile blob (``profile``, emitted by the
+  span-aware sampling profiler under ``GGRS_HOST_PROFILE=1``), the FAIL
+  detail names the stack frame whose self-time *share of its stage*
+  grew most against baseline. Shares — not absolute milliseconds — so
+  run length and host speed cancel; a clean pass stays silent.
 
 Usage (CI)::
 
@@ -124,6 +130,65 @@ def collect_baselines(paths: List[str]) -> Dict[str, dict]:
         for row in load_rows(p):
             base[row["metric"]] = row
     return base
+
+
+#: Minimum growth in a frame's self-time share of its stage before the
+#: gate names it — below this the flame diff is timer jitter, and naming
+#: a random frame on every genuine-but-unrelated regression would train
+#: people to ignore the attribution line.
+BLAME_MIN_SHARE_GROWTH = 0.02
+
+
+def attribute_regression(row: dict, base: Optional[dict]) -> Optional[str]:
+    """Name the stack frame that ate the regression, or ``None``.
+
+    Both rows must carry the compact ``profile`` blob
+    (``HostProfiler.profile_blob()``: ``stages -> {total_ms, self_ms:
+    {frame: ms}}``). Each frame's self-time is normalized to its stage's
+    total so the diff is run-length- and host-speed-invariant; the frame
+    with the largest share growth (past ``BLAME_MIN_SHARE_GROWTH``) is
+    named. Frames absent from baseline count as share 0 — brand-new hot
+    code is exactly what this exists to catch."""
+    cur_blob = row.get("profile")
+    base_blob = (base or {}).get("profile")
+    if not isinstance(cur_blob, dict) or not isinstance(base_blob, dict):
+        return None
+
+    def shares(blob: dict) -> Dict[tuple, float]:
+        out: Dict[tuple, float] = {}
+        for stage, st in (blob.get("stages") or {}).items():
+            if not isinstance(st, dict):
+                continue
+            try:
+                total = float(st.get("total_ms") or 0.0)
+            except (TypeError, ValueError):
+                continue
+            if total <= 0.0:
+                continue
+            for frame, ms in (st.get("self_ms") or {}).items():
+                try:
+                    out[(stage, frame)] = float(ms) / total
+                except (TypeError, ValueError):
+                    continue
+        return out
+
+    cur = shares(cur_blob)
+    old = shares(base_blob)
+    if not cur:
+        return None
+    best_key, best_growth = None, BLAME_MIN_SHARE_GROWTH
+    for key, share in sorted(cur.items()):
+        growth = share - old.get(key, 0.0)
+        if growth > best_growth:
+            best_key, best_growth = key, growth
+    if best_key is None:
+        return None
+    stage, frame = best_key
+    return (
+        f"profile blames `{frame}` in stage {stage} "
+        f"(self-time share {old.get(best_key, 0.0):.1%} -> "
+        f"{cur[best_key]:.1%})"
+    )
 
 
 def check_row(row: dict, base: Optional[dict],
@@ -405,12 +470,13 @@ def check_row(row: dict, base: Optional[dict],
         return out
     limit = base["value"] * (1.0 + rel_tol) + abs_tol
     if v > limit:
-        out.update(
-            status="FAIL",
-            detail=f"{v:.3f} ms > allowed {limit:.3f} ms "
-                   f"(baseline {base['value']:.3f} ms, "
-                   f"+{rel_tol:.0%} rel +{abs_tol} ms abs)",
-        )
+        detail = (f"{v:.3f} ms > allowed {limit:.3f} ms "
+                  f"(baseline {base['value']:.3f} ms, "
+                  f"+{rel_tol:.0%} rel +{abs_tol} ms abs)")
+        blame = attribute_regression(row, base)
+        if blame:
+            detail += "; " + blame
+        out.update(status="FAIL", detail=detail)
     else:
         out.update(status="ok",
                    detail=f"{v:.3f} ms <= allowed {limit:.3f} ms")
