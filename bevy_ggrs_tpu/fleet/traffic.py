@@ -21,10 +21,13 @@ discipline as :class:`~bevy_ggrs_tpu.chaos.plan.ChaosPlan`:
 
 :class:`Matchmaker` routes due arrivals through
 :meth:`~bevy_ggrs_tpu.fleet.balancer.FleetBalancer.place_match` onto
-fleet placements, holding each arrival in its **matchmake** stage until
-the last player's join delay has elapsed, then carrying an
-:class:`~bevy_ggrs_tpu.serve.admission.AdmissionTrace` through place ->
-slot-warm -> admit -> first-frame-served. Abandons retire live matches
+fleet placements, holding each arrival until the last player's join
+delay has elapsed, then starting an :class:`~bevy_ggrs_tpu.serve.
+admission.AdmissionTrace` and carrying it through matchmake (session/
+input assembly) -> place -> slot-warm -> admit -> first-frame-served.
+The join-delay wait itself is plan-scheduled (open-loop) and is NOT
+billed to admission latency — it surfaces as a ``matchmake_wait``
+tracer instant instead. Abandons retire live matches
 (or cancel still-matchmaking arrivals); spectator subscribes resolve
 their target fraction against the live match set and count against it.
 """
@@ -266,7 +269,7 @@ class Matchmaker:
         self.metrics = metrics if metrics is not None else null_metrics
         self.tracer = tracer if tracer is not None else null_tracer
         self._pending = sorted(plan.events, key=lambda e: (e.at, _order(e)))
-        self._matchmaking: List[Tuple[MatchArrival, AdmissionTrace]] = []
+        self._matchmaking: List[MatchArrival] = []
         self.live: Dict[int, int] = {}  # match_id -> server_id
         self.traces: Dict[int, AdmissionTrace] = {}
         self.spectators: Dict[int, int] = {}
@@ -344,12 +347,13 @@ class Matchmaker:
             if isinstance(e, MatchArrival):
                 self.arrivals_seen += 1
                 applied["arrivals"] += 1
-                trace = AdmissionTrace(
-                    e.match_id, clock=self._clock, tracer=self.tracer
-                )
-                trace.begin("matchmake")
-                self.traces[e.match_id] = trace
-                self._matchmaking.append((e, trace))
+                # No trace yet: the join-delay window is the PLAN's wait
+                # (open-loop, outside the system's control), so it must
+                # not be billed as admission latency. The AdmissionTrace
+                # starts when the last player joins (below) — its
+                # matchmake stage then measures real matchmaker work
+                # (session/input assembly in _admit), not the wait.
+                self._matchmaking.append(e)
                 self.metrics.count("traffic_arrivals")
             elif isinstance(e, MatchAbandon):
                 mid = self._resolve(e.target_frac)
@@ -360,8 +364,7 @@ class Matchmaker:
                     # No live match yet: cancel the oldest matchmaking
                     # arrival instead (a party dissolving pre-admission).
                     if self._matchmaking:
-                        arr, trace = self._matchmaking.pop(0)
-                        trace.finish()
+                        self._matchmaking.pop(0)
                         self.abandons_cancelled += 1
                         self.metrics.count("traffic_abandons_cancelled")
             elif isinstance(e, SpectatorSubscribe):
@@ -376,14 +379,29 @@ class Matchmaker:
                     self.metrics.count("traffic_spectates")
                     self.tracer.instant("traffic_spectate", match=mid)
         # Matchmaking completes when the slowest join delay has elapsed.
-        still: List[Tuple[MatchArrival, AdmissionTrace]] = []
-        for arrival, trace in self._matchmaking:
+        # The trace is born HERE: admission_ms measures the system's
+        # pipeline (matchmake work -> place -> slot_warm -> admit ->
+        # first_frame), never the plan-scheduled join wait. The wait
+        # stays visible as a tracer instant for timeline forensics.
+        still: List[MatchArrival] = []
+        for arrival in self._matchmaking:
             if arrival.ready_at <= now:
-                trace.end("matchmake")
+                trace = AdmissionTrace(
+                    arrival.match_id, clock=self._clock, tracer=self.tracer
+                )
+                self.traces[arrival.match_id] = trace
+                self.tracer.instant(
+                    "matchmake_wait",
+                    match=arrival.match_id,
+                    plan_wait_ms=round(
+                        (arrival.ready_at - arrival.at) * 1000.0, 4
+                    ),
+                    flow=trace.key,
+                )
                 self._admit(arrival, trace)
                 applied["admissions"] += 1
             else:
-                still.append((arrival, trace))
+                still.append(arrival)
         self._matchmaking = still
         return applied
 

@@ -143,6 +143,16 @@ def _load():
         u8p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, u8p,
         ctypes.c_int32, i32p, i32p]
     lib.ggrs_match_prefix.restype = None
+    vpp = ctypes.POINTER(ctypes.c_void_p)
+    lib.ggrs_batch_stage.argtypes = [
+        vpp, ctypes.c_int32, ctypes.c_int32, u8p, i32p, i32p, u8p, u8p,
+        vpp, i32p, i32p, ctypes.c_int32, i32p, i32p, u8p, i32p, i64p,
+        ctypes.c_int32, ctypes.c_int32, i32p]
+    lib.ggrs_batch_stage.restype = ctypes.c_int
+    lib.ggrs_batch_build.argtypes = [
+        vpp, ctypes.c_int32, u8p, u8p, vpp, i32p, vpp, u8p, u8p, u8p,
+        u8p, u8p, u8p, ctypes.c_uint64, ctypes.c_int32, u8p, u64p]
+    lib.ggrs_batch_build.restype = ctypes.c_int
     _lib = lib
     return lib
 

@@ -852,4 +852,131 @@ void ggrs_match_prefix(const uint8_t* bb, int32_t num_branches,
                     needed, k, out_branch, out_depth);
 }
 
+// --------------------------------------------------------- Batched plane
+//
+// The serving loop's per-slot host work, consolidated into two calls per
+// dispatch. Stage 1 (ggrs_batch_stage) runs before the host sizes
+// commits: as-used log appends, corrected-history branch matches against
+// the in-flight speculation, and the predictor's as-used window gather.
+// Stage 2 (ggrs_batch_build) runs after: predictor seeding + branch-tree
+// builds and no-op-lane tree re-use copies straight into the dispatch's
+// [S, B, F] jit argument buffer. Both loop over the existing per-slot
+// primitives above, so the batched path is bitwise identical to per-slot
+// calls by construction. Per-slot order inside stage 1 — log, then
+// match, then gather — mirrors the Python dispatch (log writes land
+// before the match walks them and before the window reads them).
+
+// step_bits is [S, max_frames, frame_bytes] raw; each slot reads its own
+// n_steps rows. out_branch[i] is -1 when the match declined (log gap) or
+// never ran; out_wins is [S, win_frames, P] int32, written in full for
+// win_mask slots (-1 for absent/negative frames and out-of-universe
+// values, which map to their LAST universe index — dict-build order).
+int ggrs_batch_stage(void* const* builders, int32_t num_slots,
+                     int32_t max_frames, const uint8_t* log_mask,
+                     const int32_t* starts, const int32_t* n_steps,
+                     const uint8_t* step_bits, const uint8_t* match_mask,
+                     const uint8_t* const* res_ptrs,
+                     const int32_t* res_anchors, const int32_t* load_frames,
+                     int32_t cap, int32_t* out_branch, int32_t* out_depth,
+                     const uint8_t* win_mask, const int32_t* win_anchors,
+                     const int64_t* win_universe, int32_t n_universe,
+                     int32_t win_frames, int32_t* out_wins) {
+  for (int32_t i = 0; i < num_slots; ++i) {
+    if (!log_mask[i] && !match_mask[i] && (!win_mask || !win_mask[i]))
+      continue;
+    auto* sb = static_cast<SpecBuilder*>(builders[i]);
+    if (!sb) return -3;
+    const size_t fb = sb->frame_bytes();
+    const uint8_t* steps = step_bits + size_t(i) * size_t(max_frames) * fb;
+    if (log_mask[i]) {
+      for (int32_t t = 0; t < n_steps[i]; ++t)
+        sb->log[starts[i] + t].assign(steps + size_t(t) * fb,
+                                      steps + size_t(t + 1) * fb);
+    }
+    if (match_mask[i]) {
+      out_branch[i] = -1;
+      if (ggrs_sb_match(builders[i], res_ptrs[i], res_anchors[i],
+                        load_frames[i], steps, n_steps[i], cap,
+                        out_branch + i, out_depth + i) != 0)
+        out_branch[i] = -1;
+    }
+    if (win_mask && win_mask[i]) {
+      // predict/model.BoundPredictor.window_indices, in-process. Scalar
+      // payload contract (K == 1): the Python gather reshapes each log
+      // row to [P], so the plane is only installed for K == 1 specs.
+      const int P = sb->P;
+      int32_t* out =
+          out_wins + size_t(i) * size_t(win_frames) * size_t(P);
+      for (int32_t w = 0; w < win_frames; ++w) {
+        const int32_t frame = win_anchors[i] - win_frames + w;
+        const uint8_t* row = nullptr;
+        if (frame >= 0) {
+          auto it = sb->log.find(frame);
+          if (it != sb->log.end()) row = it->second.data();
+        }
+        for (int h = 0; h < P; ++h) {
+          int32_t idx = -1;
+          if (row) {
+            const int64_t v =
+                decode_elem(row + size_t(h) * sb->row_bytes(), sb->elem,
+                            sb->is_signed);
+            for (int32_t u = n_universe - 1; u >= 0; --u)
+              if (win_universe[u] == v) {
+                idx = u;
+                break;
+              }
+          }
+          out[size_t(w) * size_t(P) + size_t(h)] = idx;
+        }
+      }
+    }
+  }
+  return 0;
+}
+
+// known is [S, F, frame_bytes] raw (ignored per slot when qs_ptrs[i] is
+// set), mask [S, F, P] 0/1, seed_traj [S, F, frame_bytes], seed_cand
+// [S, P*K, R] element bytes, seed_valid [P*K, R] 0/1 (shared across
+// slots — one bound predictor), out_bits [S, B, F, frame_bytes]. A
+// copy_mask slot re-uses its in-flight tree (res_ptrs[i]) verbatim;
+// build_mask slots run the full seeded build. Returns the first nonzero
+// ggrs_sb_build rc.
+int ggrs_batch_build(void* const* builders, int32_t num_slots,
+                     const uint8_t* build_mask, const uint8_t* copy_mask,
+                     const uint8_t* const* res_ptrs, const int32_t* anchors,
+                     void* const* qs_ptrs, const uint8_t* known,
+                     const uint8_t* mask, const uint8_t* seed_mask,
+                     const uint8_t* seed_traj, const uint8_t* seed_cand,
+                     const uint8_t* seed_valid, uint64_t seed_hash,
+                     int32_t seed_R, uint8_t* out_bits, uint64_t* out_sigs) {
+  for (int32_t i = 0; i < num_slots; ++i) {
+    if (!build_mask[i] && !copy_mask[i]) continue;
+    auto* sb = static_cast<SpecBuilder*>(builders[i]);
+    if (!sb) return -3;
+    const size_t fb = sb->frame_bytes();
+    const size_t tree_bytes = size_t(sb->B) * size_t(sb->F) * fb;
+    uint8_t* dst = out_bits + size_t(i) * tree_bytes;
+    if (copy_mask[i]) {
+      if (res_ptrs[i] != dst) std::memcpy(dst, res_ptrs[i], tree_bytes);
+      continue;
+    }
+    if (seed_mask && seed_mask[i]) {
+      const size_t PK = size_t(sb->P) * size_t(sb->K);
+      ggrs_sb_seed(
+          builders[i], anchors[i], seed_hash,
+          seed_traj + size_t(i) * size_t(sb->F) * fb,
+          seed_cand + size_t(i) * PK * size_t(seed_R) * size_t(sb->elem),
+          seed_valid, seed_R);
+    }
+    uint64_t sig = 0;
+    const int rc = ggrs_sb_build(
+        builders[i], qs_ptrs ? qs_ptrs[i] : nullptr, anchors[i],
+        known + size_t(i) * size_t(sb->F) * fb,
+        mask + size_t(i) * size_t(sb->F) * size_t(sb->P), 0, 0, dst, &sig);
+    if (out_sigs) out_sigs[i] = sig;
+    if (rc != 0) return rc;
+  }
+  return 0;
+}
+
 }  // extern "C"

@@ -251,6 +251,159 @@ def make_spec_builder(
     )
 
 
+class NativeBatchPlane:
+    """One-call-per-dispatch SoA staging for the batched serving core.
+
+    Persistent ``[S, …]`` host buffers reused across dispatches, with
+    per-slot builder handles installed on admit and dropped on retire.
+    :meth:`stage` covers the pre-commit per-slot host loop (as-used log
+    appends, in-flight tree matches, predictor window gather);
+    :meth:`build` covers the post-commit loop (predictor seeding +
+    branch-tree builds + no-op-lane tree re-use) and writes straight
+    into the dispatch's ``[S, B, F]`` jit argument buffer. The C side
+    loops over the same per-slot primitives the per-slot bindings call,
+    so the batched path is bitwise identical by construction
+    (property-tested in ``tests/test_native_batch.py``).
+    """
+
+    def __init__(
+        self, zero: np.ndarray, num_players: int, num_slots: int,
+        num_branches: int, spec_frames: int, max_frames: int,
+        predictor=None,
+    ):
+        zero = np.asarray(zero)  # zeros_np(P): [P, *shape]
+        self._dtype = zero.dtype
+        self._shape = zero.shape[1:]
+        P = self._P = int(num_players)
+        S = self._S = int(num_slots)
+        self._B = int(num_branches)
+        F = self._F = int(spec_frames)
+        MF = self._MF = int(max_frames)
+        self._builders = (ctypes.c_void_p * S)()
+        self._res_ptrs = (ctypes.c_void_p * S)()
+        self._res_refs: list = [None] * S
+        self._qs_ptrs = (ctypes.c_void_p * S)()
+        # stage 1: log appends + in-flight tree matches
+        self.log_mask = np.zeros(S, np.uint8)
+        self.starts = np.zeros(S, np.int32)
+        self.n_steps = np.zeros(S, np.int32)
+        self.steps = np.zeros((S, MF, P) + self._shape, self._dtype)
+        self.status = np.zeros((S, MF, P), np.int32)  # host-side only
+        self.match_mask = np.zeros(S, np.uint8)
+        self.res_anchors = np.zeros(S, np.int32)
+        self.load_frames = np.zeros(S, np.int32)
+        self.out_branch = np.full(S, -1, np.int32)
+        self.out_depth = np.zeros(S, np.int32)
+        # stage 2: tree builds / re-use copies
+        self.build_mask = np.zeros(S, np.uint8)
+        self.copy_mask = np.zeros(S, np.uint8)
+        self.anchors = np.zeros(S, np.int32)
+        self.known = np.zeros((S, F, P) + self._shape, self._dtype)
+        self.kmask = np.zeros((S, F, P), np.uint8)
+        self.out_sigs = np.zeros(S, np.uint64)
+        # predictor window gather + seed render (scalar-payload contract:
+        # the plane is only installed when K == 1, see make_batch_plane)
+        self._predictor = predictor
+        if predictor is not None:
+            self._universe = np.ascontiguousarray(
+                np.asarray(predictor.universe, dtype=np.int64)
+            )
+            V = self._V = int(self._universe.size)
+            W = self._W = int(predictor.weights.window)
+            self._seed_hash = int(predictor.content_hash)
+            self.win_mask = np.zeros(S, np.uint8)
+            self.win_anchors = np.zeros(S, np.int32)
+            self.wins = np.full((S, W, P), -1, np.int32)
+            self.seed_mask = np.zeros(S, np.uint8)
+            self.seed_traj = np.zeros((S, F, P), self._dtype)
+            self.seed_cand = np.zeros((S, P, V), self._dtype)
+            self._seed_valid = np.ones(P * V, np.uint8)
+
+    # Slot lifecycle -----------------------------------------------------
+
+    def set_builder(self, slot: int, builder: Optional[NativeSpecBuilder]):
+        self._builders[slot] = builder._ptr if builder is not None else None
+
+    def set_res(self, slot: int, arr: Optional[np.ndarray]):
+        """Point the slot's in-flight tree at ``arr`` (a contiguous
+        ``[B, F, P, *shape]`` row, kept referenced here for the call)."""
+        self._res_refs[slot] = arr
+        self._res_ptrs[slot] = arr.ctypes.data if arr is not None else None
+
+    def set_qs(self, slot: int, qs_ptr: Optional[int]):
+        self._qs_ptrs[slot] = qs_ptr
+
+    def reset_masks(self) -> None:
+        self.log_mask[:] = 0
+        self.match_mask[:] = 0
+        self.build_mask[:] = 0
+        self.copy_mask[:] = 0
+        if self._predictor is not None:
+            self.win_mask[:] = 0
+            self.seed_mask[:] = 0
+
+    # Batched calls ------------------------------------------------------
+
+    def stage(self, cap: int) -> None:
+        pred = self._predictor is not None
+        rc = _core._lib.ggrs_batch_stage(
+            self._builders, self._S, self._MF,
+            _u8p(self.log_mask), _i32p(self.starts), _i32p(self.n_steps),
+            _u8p(self.steps), _u8p(self.match_mask),
+            self._res_ptrs, _i32p(self.res_anchors),
+            _i32p(self.load_frames), int(cap),
+            _i32p(self.out_branch), _i32p(self.out_depth),
+            _u8p(self.win_mask) if pred else None,
+            _i32p(self.win_anchors) if pred else None,
+            self._universe.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int64)
+            ) if pred else None,
+            self._V if pred else 0, self._W if pred else 0,
+            _i32p(self.wins) if pred else None,
+        )
+        if rc != 0:
+            raise RuntimeError(f"ggrs_batch_stage failed: rc={rc}")
+
+    def build(self, bb_out: np.ndarray) -> None:
+        pred = self._predictor is not None
+        rc = _core._lib.ggrs_batch_build(
+            self._builders, self._S,
+            _u8p(self.build_mask), _u8p(self.copy_mask), self._res_ptrs,
+            _i32p(self.anchors), self._qs_ptrs,
+            _u8p(self.known), _u8p(self.kmask),
+            _u8p(self.seed_mask) if pred else None,
+            _u8p(self.seed_traj) if pred else None,
+            _u8p(self.seed_cand) if pred else None,
+            _u8p(self._seed_valid) if pred else None,
+            self._seed_hash if pred else 0, self._V if pred else 0,
+            _u8p(bb_out),
+            self.out_sigs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        )
+        if rc != 0:
+            raise RuntimeError(f"ggrs_batch_build failed: rc={rc}")
+
+
+def make_batch_plane(
+    input_spec, num_players: int, num_slots: int, num_branches: int,
+    spec_frames: int, max_frames: int, predictor=None,
+) -> Optional[NativeBatchPlane]:
+    """NativeBatchPlane when the C++ core loads, the input dtype is in
+    the native contract, and (if a predictor is bound) the payload is
+    scalar per player — else None (per-slot dispatch path)."""
+    if not _core.available():
+        return None
+    zero = np.asarray(input_spec.zeros_np(int(num_players)))
+    if not _supported_dtype(zero.dtype):
+        return None
+    K = int(np.prod(zero.shape[1:], dtype=np.int64)) if zero.ndim > 1 else 1
+    if predictor is not None and K != 1:
+        return None
+    return NativeBatchPlane(
+        zero, num_players, num_slots, num_branches, spec_frames,
+        max_frames, predictor,
+    )
+
+
 def match_prefix(
     branch_bits: np.ndarray, confirmed_bits: np.ndarray
 ) -> Optional[Tuple[int, int]]:

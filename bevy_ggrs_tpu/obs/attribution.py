@@ -73,16 +73,27 @@ class AttributionProbe:
         self.host_ms = 0.0
         self.device_ms = 0.0
         self.dispatches = 0
+        self._nested_ms = 0.0
         self._counters0 = dict(compile_counters())
         self._counters_end = None
 
     @contextlib.contextmanager
     def host(self):
+        """Time a host window. ``device_wait`` windows that open INSIDE
+        this one are excluded from the host total (and counted as device
+        time, as always): on backends where dispatch can block on the
+        in-flight computation — XLA:CPU admits one — the executor call
+        itself absorbs device execution, and without the exclusion that
+        device time masquerades as host work and the verdict lies."""
         t0 = self._clock()
+        nested0 = self._nested_ms
         try:
             yield
         finally:
-            self.host_ms += (self._clock() - t0) * 1000.0
+            elapsed = (self._clock() - t0) * 1000.0
+            self.host_ms += max(
+                0.0, elapsed - (self._nested_ms - nested0)
+            )
             self.dispatches += 1
 
     @contextlib.contextmanager
@@ -91,7 +102,9 @@ class AttributionProbe:
         try:
             yield
         finally:
-            self.device_ms += (self._clock() - t0) * 1000.0
+            elapsed = (self._clock() - t0) * 1000.0
+            self.device_ms += elapsed
+            self._nested_ms += elapsed
 
     def snapshot_compiles(self) -> None:
         """Freeze the compile-counter window here. Call at the end of

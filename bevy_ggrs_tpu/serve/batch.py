@@ -52,6 +52,7 @@ logic to drift.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
 import time
@@ -384,6 +385,24 @@ class BatchedSessionCore:
             BatchedRanker(self._predictor, self.spec_frames)
             if self._predictor is not None else None
         )
+        # Native batched data plane (native/spec.NativeBatchPlane): the
+        # whole per-slot host loop — as-used log appends, in-flight tree
+        # matches, predictor window gather, branch-tree builds and no-op
+        # tree re-use — consolidated into TWO C calls per dispatch.
+        # ``GGRS_NO_NATIVE=1`` / unsupported dtypes keep the per-slot
+        # path (bitwise identical, tests/test_native_batch.py).
+        self._plane = native_spec.make_batch_plane(
+            self.input_spec, self.num_players, S, B, F,
+            self.burst_frames, self._predictor,
+        )
+        self.native_batch_calls = 0
+        self.native_batch_ms_total = 0.0
+        # Optional AttributionProbe (obs/attribution.py): when a bench
+        # attaches one, the executor call is timed as a nested
+        # device_wait so backends whose dispatch blocks on the in-flight
+        # computation (XLA:CPU admits one) don't get device execution
+        # billed as host work in the probe's enclosing host window.
+        self.attribution = None
         # Aggregate counters (per-slot views go through labeled metrics).
         self.ticks_total = 0
         self.device_dispatches_total = 0
@@ -436,6 +455,7 @@ class BatchedSessionCore:
         slot: Optional[int] = None,
         spec_on: bool = True,
         ticket: Optional[SlotTicket] = None,
+        template: Optional[tuple] = None,
     ) -> int:
         """Place a match into a free slot and return the slot number.
 
@@ -465,6 +485,15 @@ class BatchedSessionCore:
                 )
             new_ring = ticket.ring
             state = jax.tree_util.tree_map(jnp.asarray, ticket.state)
+        elif template is not None and initial_state is None:
+            # Pre-warmed admission (MatchServer's slot template pool): a
+            # codec-round-tripped (ring, state) pair built once at
+            # warmup. Bitwise identical to the cold path below — the
+            # codec decode reproduces the template flat-byte exact, and
+            # ring_init is deterministic — so template-admitted and
+            # cold-admitted matches are indistinguishable
+            # (tests/test_native_batch.py pins this).
+            new_ring, state = template
         else:
             state = (
                 self._template if initial_state is None
@@ -487,6 +516,8 @@ class BatchedSessionCore:
         s.input_log = (
             native_spec.MirroredLog(s.native) if s.native is not None else {}
         )
+        if self._plane is not None:
+            self._plane.set_builder(slot, s.native)
         if ticket is not None and ticket.input_log:
             # MirroredLog.update forwards into the native builder's C++
             # mirror, so readmitted slots rank/fingerprint from the same
@@ -515,6 +546,10 @@ class BatchedSessionCore:
         # Reports already queued for this slot's session must survive the
         # retire (they carry their own session refs) — flush now.
         self.flush_reports()
+        if self._plane is not None:
+            self._plane.set_builder(slot, None)
+            self._plane.set_res(slot, None)
+            self._plane.set_qs(slot, None)
         s.active = False
         s.native = None
         s.input_log = {}
@@ -662,10 +697,23 @@ class BatchedSessionCore:
         every other slot no-ops (and, if it has a pending rollout, replays
         it bitwise so the wholesale prev-buffer swap preserves it).
 
+        Routes to the native batch plane when it loaded (ONE C call for
+        the per-slot host work, :meth:`_dispatch_native`) or the per-slot
+        Python loop (:meth:`_dispatch_python`) — bitwise identical paths,
+        property-tested in tests/test_native_batch.py.
+
         Atomic on fault: segments are re-validated in a pre-pass (direct
         callers may bypass :meth:`tick`), so a raise can only happen before
         the first input-log write or device dispatch — a sibling slot's
         next-tick output is bitwise unaffected by another slot faulting."""
+        if self._plane is not None:
+            return self._dispatch_native(batch)
+        return self._dispatch_python(batch)
+
+    def _dispatch_python(self, batch: Dict[int, tuple]) -> None:
+        """The per-slot host loop (the ``GGRS_NO_NATIVE=1`` reference
+        path): log writes, branch matches, window gather and tree builds
+        all run per slot in Python."""
         S, B, F, MF = (
             self.num_slots, self.num_branches, self.spec_frames,
             self.burst_frames,
@@ -886,17 +934,36 @@ class BatchedSessionCore:
             self.timeseries.observe("serve_branch_build_ms", bb_ms)
             self.timeseries.observe("serve_arg_assembly_ms", arg_ms)
 
+        self._finish_dispatch(
+            (branch_a, absorb_first_a, absorb_n_a, prev_anchor_a,
+             prev_total_a, do_load_a, load_frame_a, start_frame_a,
+             bits_a, status_a, save_mask_a, adv_mask_a,
+             from_live_a, spec_anchor_a, bb_a),
+            post, reports,
+        )
+
+    def _finish_dispatch(
+        self, jit_args: tuple, post: Dict[int, tuple],
+        reports: List[tuple],
+    ) -> None:
+        """The device dispatch + post-dispatch bookkeeping shared by both
+        host paths (per-slot Python loop and native batch plane): run the
+        batched tick, then apply frame counters, rollout metadata,
+        hit/miss counters, ledger entries and deferred checksum rows."""
+        branch_a = jit_args[0]
         self.device_dispatches_total += 1
-        with self.metrics.timer("serve_dispatch"):
+        dev = (
+            self.attribution.device_wait()
+            if self.attribution is not None
+            else contextlib.nullcontext()
+        )
+        with self.metrics.timer("serve_dispatch"), dev:
             (
                 self.rings, self.states, absorb_cs, burst_cs,
                 self.prev_rings, self.prev_states, _spec_cs,
             ) = self._exec.run(
                 self.rings, self.states, self.prev_rings, self.prev_states,
-                branch_a, absorb_first_a, absorb_n_a, prev_anchor_a,
-                prev_total_a, do_load_a, load_frame_a, start_frame_a,
-                bits_a, status_a, save_mask_a, adv_mask_a,
-                from_live_a, spec_anchor_a, bb_a,
+                *jit_args,
             )
 
         for i, (
@@ -974,6 +1041,262 @@ class BatchedSessionCore:
                     )
             self._gc_log(s)
         self._pending_reports.extend(reports)
+
+    def _dispatch_native(self, batch: Dict[int, tuple]) -> None:
+        """One vmapped dispatch with the per-slot host loop consolidated
+        into the two batch-plane calls: ``ggrs_batch_stage`` lands every
+        slot's as-used log rows, in-flight tree match and predictor
+        window gather in ONE C call before the commit decisions, and
+        ``ggrs_batch_build`` runs every seeded tree build plus the no-op
+        lanes' tree re-use copies straight into the dispatch's jit
+        argument buffer. Bitwise identical to :meth:`_dispatch_python`
+        (the C side loops over the same per-slot primitives)."""
+        plane = self._plane
+        S, B, F, MF = (
+            self.num_slots, self.num_branches, self.spec_frames,
+            self.burst_frames,
+        )
+        P = self.num_players
+        for i, (load_frame, steps, _confirmed, _session) in batch.items():
+            self._validate_segment(i, self.slots[i].frame, load_frame, steps)
+        i32 = lambda: np.zeros(S, np.int32)
+        branch_a, absorb_first_a, absorb_n_a = i32(), i32(), i32()
+        prev_anchor_a, prev_total_a = i32(), i32()
+        load_frame_a, start_frame_a, spec_anchor_a = i32(), i32(), i32()
+        do_load_a = np.zeros(S, bool)
+        from_live_a = np.ones(S, bool)
+        save_mask_a = np.zeros((S, MF), bool)
+        adv_mask_a = np.zeros((S, MF), bool)
+        bits_a = np.zeros((S, MF) + self._zero.shape, self._zero.dtype)
+        status_a = np.zeros((S, MF, P), np.int32)
+        # Fresh per dispatch (NOT a reused plane buffer): the previous
+        # dispatch's rows live on as the slots' in-flight trees
+        # (res_bits views) until the post pass replaces them, and the jit
+        # argument transfer may still read them asynchronously.
+        bb_a = np.zeros((S, B, F) + self._zero.shape, self._zero.dtype)
+        post: Dict[int, tuple] = {}
+        reports: List[tuple] = []
+
+        measure = self._measure_host
+        t_loop = time.perf_counter() if measure else 0.0
+        tok_loop = push_span("serve_arg_assembly") if measure else None
+        bb_ms = 0.0
+        rank_ms = 0.0
+        nb_ms = 0.0
+        plane.reset_masks()
+        # Pass 1 — SoA staging for ggrs_batch_stage: step bits/status,
+        # anchor geometry, match inputs, window-gather requests. The
+        # Python-side dict update bypasses MirroredLog's per-row ctypes
+        # forward — the stage call lands the same rows in the native
+        # mirror (in per-slot log -> match -> gather order, mirroring
+        # the Python pass structure).
+        geom: Dict[int, tuple] = {}
+        for i, (load_frame, steps, confirmed, _session) in batch.items():
+            s = self.slots[i]
+            start = s.frame if load_frame is None else load_frame
+            end = start + len(steps)
+            anchor = end if confirmed is None else confirmed + 1
+            plane.log_mask[i] = 1
+            plane.starts[i] = start
+            plane.n_steps[i] = len(steps)
+            for t, st in enumerate(steps):
+                arr = np.asarray(st.adv.bits)
+                dict.__setitem__(s.input_log, start + t, arr)
+                plane.steps[i, t] = arr
+                plane.status[i, t] = np.asarray(st.adv.status, np.int32)
+            if (
+                load_frame is not None
+                and s.res_anchor is not None
+                and load_frame >= s.res_anchor
+            ):
+                plane.match_mask[i] = 1
+                plane.res_anchors[i] = s.res_anchor
+                plane.load_frames[i] = load_frame
+                plane.set_res(i, s.res_bits)
+            spec_active = (
+                s.spec_on and anchor <= end and anchor > end - self.ring_depth
+            )
+            if self._ranker is not None and spec_active:
+                plane.win_mask[i] = 1
+                plane.win_anchors[i] = anchor
+            geom[i] = (start, end, anchor, spec_active)
+        with self.tracer.span("serve_native_batch", call="stage"):
+            t_nb = time.perf_counter() if measure else 0.0
+            tok_nb = push_span("serve_native_batch") if measure else None
+            plane.stage(F)
+            if tok_nb is not None:
+                pop_span(tok_nb)
+            if measure:
+                nb_ms += (time.perf_counter() - t_nb) * 1000.0
+        self.native_batch_calls += 1
+        self.metrics.count("native_batch_calls")
+        if self._ranker is not None:
+            eligible = [i for i in batch if geom[i][3]]
+            if eligible:
+                t_rank = time.perf_counter()
+                tok_rank = (
+                    push_span("serve_predictor_rank") if measure else None
+                )
+                anchors = np.zeros(S, dtype=np.int32)
+                el = np.asarray(eligible, dtype=np.intp)
+                anchors[el] = plane.win_anchors[el]
+                # Stale non-eligible window rows are fine: the ranker is
+                # a vmapped lane-independent forward, and only the
+                # eligible rows' outputs are consumed.
+                traj_idx, order = self._ranker.rank(plane.wins, anchors)
+                # render_seed vectorized over the eligible rows — the
+                # same universe gather + dtype cast per slot; the shared
+                # all-ones valid plane lives in the batch plane.
+                uni = self._predictor.universe
+                plane.seed_traj[el] = uni[traj_idx[el]]
+                plane.seed_cand[el] = uni[order[el]]
+                plane.seed_mask[el] = 1
+                if tok_rank is not None:
+                    pop_span(tok_rank)
+                rank_ms = (time.perf_counter() - t_rank) * 1000.0
+                self.last_predictor_rank_ms = rank_ms
+                self.predictor_rank_ms_total += rank_ms
+                self.predictor_rank_dispatches += 1
+                self.metrics.observe("predictor_rank_ms", rank_ms)
+                self.timeseries.observe("predictor_rank_ms", rank_ms)
+        # Pass 2 — commit decisions from the staged match results, then
+        # build-call staging (anchors, known inputs, no-op copies) and
+        # the per-slot scalar fills for the jit arguments.
+        dirty_known: List[int] = []
+        for s in self.slots:
+            i = s.index
+            if i not in batch:
+                start_frame_a[i] = s.frame
+                if s.res_anchor is not None:
+                    spec_anchor_a[i] = s.res_anchor
+                    from_live_a[i] = s.res_from_live
+                    plane.copy_mask[i] = 1
+                    plane.set_res(i, s.res_bits)
+                else:
+                    spec_anchor_a[i] = s.frame
+                continue
+            load_frame, steps, confirmed, session = batch[i]
+            start, end, anchor, spec_active = geom[i]
+            n_steps = len(steps)
+            absorb_branch, n_commit = 0, 0
+            missed = False
+            blame_player = blame_frame = None
+            if plane.match_mask[i]:
+                br = int(plane.out_branch[i])
+                if br >= 0:  # -1 = as-used log gap (the Python no-match)
+                    depth = int(plane.out_depth[i])
+                    nc = min(depth - (load_frame - s.res_anchor), n_steps)
+                    if nc > 0:
+                        absorb_branch, n_commit = br, int(nc)
+                    else:
+                        missed = True
+                        self.spec_misses += 1
+                        self.metrics.count("spec_misses")
+                        self.metrics.count(
+                            "spec_misses", labels={"match_slot": i}
+                        )
+                    if self.ledger.enabled:
+                        pre = load_frame - s.res_anchor
+                        k = min(n_steps, F - pre)
+                        if k > 0:
+                            div = blame_divergence(
+                                np.asarray(s.res_bits)[0][pre:pre + k],
+                                plane.steps[i, :k],
+                            )
+                            if div is not None:
+                                blame_player = div[1]
+                                blame_frame = load_frame + div[0]
+            if spec_active:
+                plane.build_mask[i] = 1
+                plane.anchors[i] = anchor
+                qs_ptr = (
+                    s.native.qset_ptr(session) if session is not None
+                    else None
+                )
+                plane.set_qs(i, qs_ptr)
+                if qs_ptr is None and session is not None and (
+                    getattr(session, "confirmed_span", None) is not None
+                    or getattr(session, "confirmed_input", None) is not None
+                ):
+                    # Sessions with a confirmed-inputs surface but no
+                    # native queue set: the Python bulk query fills this
+                    # slot's known rows (re-zeroed after the build).
+                    known, kmask = s.shim._known_inputs(anchor, session)
+                    plane.known[i] = known
+                    plane.kmask[i] = kmask
+                    dirty_known.append(i)
+                spec_anchor, from_live = anchor, (anchor == end)
+            else:
+                spec_anchor, from_live = end, True
+            if n_commit > 0:
+                burst_load, burst_start = None, load_frame + n_commit
+            else:
+                burst_load, burst_start = load_frame, start
+            branch_a[i] = absorb_branch
+            absorb_first_a[i] = load_frame if load_frame is not None else 0
+            absorb_n_a[i] = n_commit
+            prev_anchor_a[i] = s.res_anchor or 0
+            prev_total_a[i] = F if s.res_anchor is not None else 0
+            do_load_a[i] = burst_load is not None
+            load_frame_a[i] = burst_load if burst_load is not None else 0
+            start_frame_a[i] = burst_start
+            n_tail = n_steps - n_commit
+            save_mask_a[i, :n_tail] = True
+            adv_mask_a[i, :n_tail] = True
+            if n_tail:
+                bits_a[i, :n_tail] = plane.steps[i, n_commit:n_steps]
+                status_a[i, :n_tail] = plane.status[i, n_commit:n_steps]
+            spec_anchor_a[i] = spec_anchor
+            from_live_a[i] = from_live
+            # The slot's next in-flight tree is its bb_a row, written by
+            # the build call below — the view is stored now, the bytes
+            # land before the device dispatch reads them.
+            post[i] = (
+                end, spec_active, anchor if spec_active else None,
+                bb_a[i] if spec_active else None,
+                from_live, load_frame, n_commit, n_steps, burst_start,
+                n_tail, session, missed, blame_player, blame_frame,
+            )
+        with self.tracer.span("serve_native_batch", call="build"):
+            t_bb = time.perf_counter() if measure else 0.0
+            tok_bb = push_span("serve_branch_build") if measure else None
+            plane.build(bb_a)
+            if tok_bb is not None:
+                pop_span(tok_bb)
+            if measure:
+                bb_ms = (time.perf_counter() - t_bb) * 1000.0
+                nb_ms += bb_ms
+        self.native_batch_calls += 1
+        self.metrics.count("native_batch_calls")
+        for i in dirty_known:
+            plane.known[i] = 0
+            plane.kmask[i] = 0
+
+        if tok_loop is not None:
+            pop_span(tok_loop)
+        if measure:
+            # branch_build is the build call's real measured wall time;
+            # everything else in the loop (SoA staging, the stage call,
+            # commit decisions, scalar fills) is argument assembly.
+            loop_ms = (time.perf_counter() - t_loop) * 1000.0
+            arg_ms = max(0.0, loop_ms - bb_ms - rank_ms)
+            self.last_branch_build_ms = bb_ms
+            self.last_arg_assembly_ms = arg_ms
+            self.native_batch_ms_total += nb_ms
+            self.metrics.observe("serve_branch_build", bb_ms)
+            self.metrics.observe("serve_arg_assembly", arg_ms)
+            self.metrics.observe("native_batch_ms", nb_ms)
+            self.timeseries.observe("serve_branch_build_ms", bb_ms)
+            self.timeseries.observe("serve_arg_assembly_ms", arg_ms)
+            self.timeseries.observe("native_batch_ms", nb_ms)
+
+        self._finish_dispatch(
+            (branch_a, absorb_first_a, absorb_n_a, prev_anchor_a,
+             prev_total_a, do_load_a, load_frame_a, start_frame_a,
+             bits_a, status_a, save_mask_a, adv_mask_a,
+             from_live_a, spec_anchor_a, bb_a),
+            post, reports,
+        )
 
     def _gc_log(self, s: _Slot) -> None:
         horizon = s.frame - self.ring_depth - 64

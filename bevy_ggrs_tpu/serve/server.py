@@ -245,6 +245,14 @@ class MatchServer:
         self._admit_queue: List[tuple] = []
         self._pending_first: Dict[MatchHandle, object] = {}
         self.admissions_completed = 0
+        # Slot template pool (filled by warmup): codec-round-tripped
+        # (ring, state) pairs a fresh admission reuses instead of
+        # re-deriving ring_init(template) per joiner — the migration
+        # warmup trick extended to the front door. Entries are immutable
+        # device arrays (the admit program copies them into slot rows),
+        # so consuming one recycles it and the pool never drains.
+        self._slot_templates: List[tuple] = []
+        self.templates_admitted = 0
         # Server-scope SLOs over the online time-series windows (the
         # signals the front-door knee detector and the balancer read).
         self.admission_slo_ms = (
@@ -362,12 +370,14 @@ class MatchServer:
         )
 
     def free_slot_handles(self) -> List[MatchHandle]:
-        """Every admittable (group, slot), least-loaded group first — the
-        fleet balancer's stagger-aware placement domain. Reserved slots
+        """Every admittable (group, slot), busiest group with room first
+        (pack-first, same policy as :meth:`_pick_slot` — fewest hot
+        groups, fewest fixed-cost dispatch programs) — the fleet
+        balancer's stagger-aware placement domain. Reserved slots
         (recovering matches) are never offered."""
         order = sorted(
             range(len(self.groups)),
-            key=lambda g: (-len(self._free_unreserved(g)), g),
+            key=lambda g: (len(self._free_unreserved(g)), g),
         )
         return [
             MatchHandle(g, s)
@@ -401,14 +411,23 @@ class MatchServer:
         migration blob codec: landing a migrated-in match is steady state
         for a fleet destination, and the decode-side device re-upload
         programs are shape-specialized and process-local, so without this
-        the FIRST landing would retrace (a churn_recompiles violation)."""
+        the FIRST landing would retrace (a churn_recompiles violation).
+
+        The decoded record seeds the **slot template pool**: fresh
+        admissions (``initial_state=None``) reuse its pre-built
+        ``(ring_init(state), state)`` pair instead of re-deriving it per
+        joiner, so the per-admission device-upload prep amortizes to ~0.
+        The codec round-trip is the bitwise witness — the decoded state
+        is flat-byte identical to the live template, so a template-
+        admitted match is indistinguishable from a cold-admitted one
+        (tests/test_native_batch.py pins this)."""
         self.groups[0].warmup()
         lane = self._make_lane_runner()
         lane.warmup()
         from .faults import pack_match_record, unpack_match_record
 
         codec = self.state_codec()
-        unpack_match_record(
+        rec = unpack_match_record(
             codec,
             pack_match_record(
                 codec,
@@ -424,6 +443,22 @@ class MatchServer:
                 },
             ),
         )
+        import jax
+
+        from bevy_ggrs_tpu.state import ring_init
+
+        tpl_state = jax.tree_util.tree_map(
+            jax.numpy.asarray, rec["ticket"].state
+        )
+        tpl_ring = ring_init(tpl_state, self.groups[0].ring_depth)
+        jax.block_until_ready(tpl_ring.frames)
+        # One entry per drain slot per group: every admission a single
+        # frame can complete finds a template waiting. All entries share
+        # the same immutable arrays — the pool is bookkeeping, not copies.
+        self._slot_templates = [
+            (tpl_ring, tpl_state)
+            for _ in range(self.admit_budget * len(self.groups))
+        ]
 
     def _make_lane_runner(self):
         from bevy_ggrs_tpu.runner import RollbackRunner
@@ -477,14 +512,25 @@ class MatchServer:
         return m
 
     def _pick_slot(self) -> MatchHandle:
-        group = max(
-            range(len(self.groups)),
-            key=lambda g: (len(self._free_unreserved(g)), -g),
-        )
-        free = self._free_unreserved(group)
-        if not free:
+        # Pack-first: the busiest group that still has room. A group's
+        # vmapped tick program costs the same at one live slot as at
+        # full occupancy, so the number of HOT groups — not the number
+        # of live matches — sets the per-frame device bill; packing
+        # keeps it minimal at partial occupancy. The least-loaded
+        # spread this replaces existed to balance the per-slot Python
+        # host loop across groups, and the batched native plane made
+        # that cost flat in occupancy.
+        candidates = [
+            g for g in range(len(self.groups))
+            if self._free_unreserved(g)
+        ]
+        if not candidates:
             raise RuntimeError("server at capacity")
-        return MatchHandle(group, free[0])
+        group = min(
+            candidates,
+            key=lambda g: (len(self._free_unreserved(g)), g),
+        )
+        return MatchHandle(group, self._free_unreserved(group)[0])
 
     def add_match(
         self,
@@ -543,6 +589,16 @@ class MatchServer:
             trace.begin("slot_warm")
         if callable(initial_state):
             initial_state = initial_state()
+        template = None
+        if initial_state is None and self._slot_templates:
+            # Pre-warmed path: pop a codec-round-tripped template and
+            # recycle it (device-immutable — admit copies, never
+            # mutates), so slot_warm is a pool pop instead of a
+            # per-joiner ring build.
+            template = self._slot_templates.pop()
+            self._slot_templates.append(template)
+            self.templates_admitted += 1
+            self.metrics.count("template_admissions")
         m = None
         try:
             if trace is not None:
@@ -552,6 +608,7 @@ class MatchServer:
                 initial_state=initial_state,
                 slot=handle.slot,
                 spec_on=spec_on,
+                template=template,
             )
             m = self._register(handle, session, local_inputs, spec_on)
         finally:
@@ -906,8 +963,37 @@ class MatchServer:
         sibling slots are untouched) drops that slot and re-ticks the
         rest. Recovery lanes step after the groups, readmitting or
         evicting as they resolve."""
-        t0 = self._clock()
         t_wall = time.perf_counter()
+        # Fast-path admission drain, TOP of frame: a pre-warmed joiner
+        # (initial_state None with a slot template pooled) costs ~a
+        # template pop + one small device-admit program, so it drains
+        # BEFORE the group loop and rides THIS frame's dispatch —
+        # first_frame loses a whole serve-frame of queue wait. Strictly
+        # FIFO: the scan stops at the first admission that needs a real
+        # state build, so nothing ever overtakes a slow joiner. Those
+        # slow/lazy builds keep the after-dispatch drain below (a slow
+        # join costs the joiner latency, never a sibling group its
+        # deadline). One admit_budget bounds both drains per frame.
+        admit_budget_left = self.admit_budget
+        while (
+            admit_budget_left > 0
+            and self._admit_queue
+            and self._admit_queue[0][3] is None
+            and self._slot_templates
+        ):
+            handle, session, local_inputs, initial_state, spec_on, trace = (
+                self._admit_queue.pop(0)
+            )
+            self._reserved[handle.group].discard(handle.slot)
+            admit_budget_left -= 1
+            with self.tracer.span(
+                "admit_fast", group=handle.group, slot=handle.slot
+            ):
+                self._admit_at(
+                    handle, session, local_inputs, initial_state, spec_on,
+                    trace,
+                )
+        t0 = self._clock()
         worst_jitter = 0.0
         by_group: Dict[int, Dict[int, Tuple[MatchHandle, _Match]]] = {}
         for handle, m in self._matches.items():
@@ -1022,6 +1108,29 @@ class MatchServer:
                             self._finish_admission(
                                 h, self._pending_first.pop(h)
                             )
+        # Slow-path admission drain: immediately AFTER every group issued
+        # its dispatch — the tick programs are still in flight on device
+        # (dispatch is async), so a joiner's session warm + state build
+        # + device-admit enqueue overlaps dispatch N instead of
+        # serializing behind the attest/lane sweeps (which block on
+        # device results). A slow join still costs the joiner latency,
+        # never a sibling group its deadline. Shares the frame's
+        # admit_budget with the fast-path drain at the top of the frame.
+        # Freshly admitted slots are attest-safe before their first
+        # dispatch: their ring frames are all -1 and attest_ring masks
+        # unoccupied rows.
+        for _ in range(min(admit_budget_left, len(self._admit_queue))):
+            handle, session, local_inputs, initial_state, spec_on, trace = (
+                self._admit_queue.pop(0)
+            )
+            self._reserved[handle.group].discard(handle.slot)
+            with self.tracer.span(
+                "admit_drain", group=handle.group, slot=handle.slot
+            ):
+                self._admit_at(
+                    handle, session, local_inputs, initial_state, spec_on,
+                    trace,
+                )
         # Periodic SDC attestation sweep, off the hot path like the lanes:
         # detection within attest_interval frames, self-healing in place.
         if (
@@ -1068,21 +1177,6 @@ class MatchServer:
                 or lane.errors > self.lane_error_limit
             ):
                 self._evict(handle, lane)
-        # Admission-queue drain: AFTER every group dispatched, so a slow
-        # join (lazy world build, big supervisor warm) costs the joiner
-        # latency, never a sibling group its deadline. Budget-bounded.
-        for _ in range(min(self.admit_budget, len(self._admit_queue))):
-            handle, session, local_inputs, initial_state, spec_on, trace = (
-                self._admit_queue.pop(0)
-            )
-            self._reserved[handle.group].discard(handle.slot)
-            with self.tracer.span(
-                "admit_drain", group=handle.group, slot=handle.slot
-            ):
-                self._admit_at(
-                    handle, session, local_inputs, initial_state, spec_on,
-                    trace,
-                )
         self.last_stagger_jitter_ms = worst_jitter
         self.frames_served += 1
         self.metrics.count("frames_served")

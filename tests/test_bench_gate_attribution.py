@@ -53,6 +53,11 @@ def row(value, profile=None, metric="front_door_S4"):
         "stage_slot_warm_p99_ms": 0.2, "stage_admit_p99_ms": 0.3,
         "stage_first_frame_p99_ms": 0.4, "branch_build_p99_ms": 0.1,
         "arg_assembly_p99_ms": 0.1,
+        # host/device attribution columns the gate requires:
+        "attr_verdict": "balanced", "attr_host_frac": 0.5,
+        # offered-rate ladder (arms the knee-floor check: the floor only
+        # applies when this run offered >= the baseline knee):
+        "ladder": [{"rate_per_sec": 2.0}, {"rate_per_sec": 4.0}],
     }
     if profile is not None:
         r["profile"] = profile
@@ -161,6 +166,52 @@ class TestCheckRowIntegration:
         )
         assert v["status"] == "FAIL"
         assert "blames" not in v["detail"]
+
+    def test_host_bound_front_door_hard_fails(self):
+        r = row(1.0)
+        r["attr_verdict"] = "host_bound"
+        r["attr_host_frac"] = 0.82
+        v = bench_gate.check_row(r, None, rel_tol=0.35, abs_tol=0.05)
+        assert v["status"] == "FAIL"
+        assert "host_bound" in v["detail"]
+
+    def test_missing_attr_verdict_hard_fails(self):
+        r = row(1.0)
+        del r["attr_verdict"]
+        v = bench_gate.check_row(r, None, rel_tol=0.35, abs_tol=0.05)
+        assert v["status"] == "FAIL"
+        assert "attr_verdict" in v["detail"]
+
+    def test_knee_regression_hard_fails_same_platform(self):
+        r = row(1.0)
+        r["knee_admissions_per_sec"] = 1.0  # baseline row() carries 3.0
+        v = bench_gate.check_row(
+            r, row(1.0), rel_tol=0.35, abs_tol=0.05
+        )
+        assert v["status"] == "FAIL"
+        assert "knee regressed" in v["detail"]
+
+    def test_knee_floor_disarmed_when_ladder_never_offered_it(self):
+        # A smoke ladder topping out below the committed knee cannot
+        # reproduce it — the floor must not arm on ladder geometry.
+        r = row(1.0)
+        r["knee_admissions_per_sec"] = 1.0
+        base = row(1.0)
+        base["knee_admissions_per_sec"] = 30.0
+        v = bench_gate.check_row(
+            r, base, rel_tol=0.35, abs_tol=0.05
+        )
+        assert v["status"] == "ok"
+
+    def test_knee_check_skips_on_platform_mismatch(self):
+        r = row(1.0)
+        r["knee_admissions_per_sec"] = 1.0
+        base = row(1.0)
+        base["platform"] = "tpu"
+        v = bench_gate.check_row(
+            r, base, rel_tol=0.35, abs_tol=0.05
+        )
+        assert v["status"] == "skipped"
 
 
 @pytest.mark.slow

@@ -150,13 +150,13 @@ def test_matchmaker_admits_with_complete_stage_traces():
         assert t.complete, t.snapshot()
         assert set(t.durations) == set(ADMISSION_STAGES)
         assert t.server_id in (0, 1)
-    # Matchmake time covers the join-delay window on the virtual clock,
-    # up to pump quantization (begin lands on the frame after `at`).
-    arr = {a.match_id: a for a in plan.arrivals()}
+    # The trace is born at matchmaking COMPLETION: the plan's join-delay
+    # wait is open-loop schedule, not admission latency. On the virtual
+    # clock, matchmake (session/input assembly inside one pump) is
+    # instantaneous, regardless of how long the arrival waited.
     for mid, t in mm.traces.items():
         if t.complete:
-            want = (arr[mid].ready_at - arr[mid].at) * 1000.0
-            assert t.durations["matchmake"] >= want - FPS_DT * 1000 - 1e-6
+            assert t.durations["matchmake"] <= 1e-6
     # Abandons retired real matches; placements were cleaned up.
     assert mm.abandons_applied > 0
     for mid in mm.live:

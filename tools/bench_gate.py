@@ -22,7 +22,11 @@ Design constraints this encodes:
   (``live_*_spec_on*``, ``serve_batched_*``) must additionally carry the
   speculation-ledger economics columns, and a ``*_spec_on*`` row with
   ``spec_full_hit_rate == 0`` fails outright: a silently dead speculation
-  path used to pass on latency alone.
+  path used to pass on latency alone. ``front_door_*`` rows additionally
+  hard-fail when the serving-loop attribution verdict reads
+  ``host_bound`` or when the admission knee (a throughput, invisible to
+  the latency diff) drops more than ``rel_tol`` below the committed
+  same-platform baseline.
 - **Regression attribution.** When a latency check fails and BOTH rows
   carry the compact host-profile blob (``profile``, emitted by the
   span-aware sampling profiler under ``GGRS_HOST_PROFILE=1``), the FAIL
@@ -354,6 +358,55 @@ def check_row(row: dict, base: Optional[dict],
                 out.update(status="FAIL",
                            detail=f"front-door row lost its {col} column")
                 return out
+        # Host/device attribution over the measured ladder: the batched
+        # native data plane exists to keep the per-frame host loop off
+        # the critical path, so a front door whose verdict reads
+        # host_bound has lost that property — hard failure regardless
+        # of where the knee landed.
+        if not row.get("attr_verdict"):
+            out.update(status="FAIL",
+                       detail="front-door row lost its host/device "
+                              "attribution verdict (attr_verdict)")
+            return out
+        if row.get("attr_verdict") == "host_bound":
+            out.update(status="FAIL",
+                       detail="front-door serving loop is host_bound "
+                              f"(attr_host_frac="
+                              f"{row.get('attr_host_frac')!r}; gate: the "
+                              "batched data plane keeps the host side "
+                              "under 60%)")
+            return out
+        # Knee regression: admissions/sec is a throughput, so the generic
+        # latency check below never sees it. Same-platform baselines arm
+        # a floor at (1 - rel_tol) x the committed knee — one full ladder
+        # step down (halving) always fails, windowing noise does not.
+        # The floor only arms when this run OFFERED a rate at or above
+        # the baseline knee: a CI smoke ladder topping out at 4/s can
+        # never reproduce a 30/s knee, and failing it for that would
+        # gate on ladder geometry, not on a regression.
+        if base is not None and base.get("platform") == row.get("platform"):
+            bknee = base.get("knee_admissions_per_sec")
+            cknee = row.get("knee_admissions_per_sec")
+            offered = [
+                e.get("rate_per_sec")
+                for e in (row.get("ladder") or [])
+                if isinstance(e, dict)
+                and isinstance(e.get("rate_per_sec"), (int, float))
+            ]
+            max_offered = max(offered, default=0.0)
+            if (
+                isinstance(bknee, (int, float)) and bknee > 0
+                and max_offered >= bknee
+            ):
+                floor = bknee * (1.0 - rel_tol)
+                if not isinstance(cknee, (int, float)) or cknee < floor:
+                    out.update(
+                        status="FAIL",
+                        detail=f"admission knee regressed: {cknee!r} adm/s "
+                               f"< floor {floor:.3f} (committed baseline "
+                               f"{bknee!r} adm/s, -{rel_tol:.0%} tolerated)",
+                    )
+                    return out
     if metric.startswith("relay_tree_"):
         # The tiered fan-out row IS its exactness gates: a spectator whose
         # drained bytes differ from the authoritative publisher, a dead
